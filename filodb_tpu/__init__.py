@@ -1,0 +1,20 @@
+"""filodb_tpu: a TPU-native, Prometheus-compatible time-series database framework.
+
+Re-designed from scratch for TPU (JAX/XLA/Pallas/pjit) with the capabilities of
+the FiloDB reference (Scala/Akka, see /root/reference):
+
+- ``memory``    : columnar chunk codecs (NibblePack, delta-delta, XOR doubles,
+                  histogram 2D-delta) — bit-compatible interchange formats plus
+                  device-friendly dense tile layouts.
+- ``core``      : record format, schemas, the in-memory time-series store
+                  (shards, partitions, write buffers, flush, tag index).
+- ``query``     : LogicalPlan -> ExecPlan -> range functions / aggregators with
+                  a numpy oracle backend and a JAX/TPU backend.
+- ``promql``    : PromQL parser producing LogicalPlans.
+- ``parallel``  : shard <-> mesh mapping, scatter-gather over jax.sharding.
+- ``store``     : persistent column store + checkpointing.
+- ``http``      : Prometheus-compatible HTTP API.
+- ``downsample``: batch downsampler driven by the same device kernels.
+"""
+
+__version__ = "0.1.0"

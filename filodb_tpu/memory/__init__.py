@@ -1,0 +1,10 @@
+"""Columnar chunk codecs and memory formats (TPU-native analogue of FiloDB's
+``memory/`` module — reference: memory/src/main/scala/filodb.memory/format/*).
+
+The reference implements these as off-heap byte manipulation via
+``sun.misc.Unsafe``; here the interchange bit formats are implemented with
+numpy/Python (bulk paths vectorized), with a C++ fast path for the ingest-side
+encoders, and decode lowering to dense device tiles for the TPU query path.
+"""
+
+from filodb_tpu.memory import nibblepack  # noqa: F401

@@ -1,0 +1,132 @@
+"""NibblePack parity tests.
+
+Golden byte vectors ported from the reference test suite
+(memory/src/test/scala/filodb.memory/format/NibblePackTest.scala) — these pin
+bit-for-bit interchange compatibility with the reference wire format.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.memory import nibblepack as nbp
+
+
+def test_pack8_partial_nonzero_even_nibbles():
+    # NibblePackTest.scala "should NibblePack 8 words partial non-zero even nibbles"
+    inputs = [
+        0,
+        0x0000003322110000, 0x0000004433220000,
+        0x0000005544330000, 0x0000006655440000,
+        0, 0, 0,
+    ]
+    out = bytearray()
+    nbp.pack8(inputs, out)
+    expected = bytes([
+        0x1E,        # bitmask
+        0x54,        # six nibbles wide, four trailing zero nibbles
+        0x11, 0x22, 0x33, 0x22, 0x33, 0x44,
+        0x33, 0x44, 0x55, 0x44, 0x55, 0x66,
+    ])
+    assert bytes(out) == expected
+
+
+def test_pack8_partial_nonzero_odd_nibbles():
+    inputs = [
+        0,
+        0x0000003322100000, 0x0000004433200000,
+        0x0000005544300000, 0x0000006655400000,
+        0x0000007654300000, 0, 0,
+    ]
+    out = bytearray()
+    nbp.pack8(inputs, out)
+    expected = bytes([
+        0x3E,
+        0x45,        # five nibbles wide, five trailing zero nibbles
+        0x21, 0x32, 0x23, 0x33, 0x44,
+        0x43, 0x54, 0x45, 0x55, 0x66,
+        0x43, 0x65, 0x07,
+    ])
+    assert bytes(out) == expected
+
+
+def test_unpack8_partial_odd_nibbles():
+    compressed = bytes([
+        0x3E, 0x45,
+        0x21, 0x32, 0x23, 0x33, 0x44,
+        0x43, 0x54, 0x45, 0x55, 0x66,
+        0x43, 0x65, 0x07,
+    ])
+    expected = [
+        0,
+        0x0000003322100000, 0x0000004433200000,
+        0x0000005544300000, 0x0000006655400000,
+        0x0000007654300000, 0, 0,
+    ]
+    out = [0] * 8
+    pos = nbp.unpack8(compressed, 0, out)
+    assert pos == len(compressed)
+    assert out == expected
+
+
+def test_pack_unpack_delta():
+    inputs = [0, 1000, 1001, 1002, 1003, 2005, 2010, 3034, 4045, 5056, 6067, 7078]
+    out = bytearray()
+    nbp.pack_delta(inputs, out)
+    got, _ = nbp.unpack_delta(bytes(out), 0, len(inputs))
+    np.testing.assert_array_equal(got, inputs)
+
+    inputs2 = [10000, 1032583228027]
+    out2 = bytearray()
+    nbp.pack_delta(inputs2, out2)
+    got2, _ = nbp.unpack_delta(bytes(out2), 0, len(inputs2))
+    np.testing.assert_array_equal(got2, inputs2)
+
+
+def test_pack_unpack_doubles():
+    inputs = [0.0, 2.5, 5.0, 7.5, 8, 13.2, 18.9, 89, 101.1, 102.3]
+    out = bytearray()
+    nbp.pack_doubles(inputs, out)
+    got, _ = nbp.unpack_double_xor(bytes(out), 0, len(inputs))
+    np.testing.assert_array_equal(got, np.asarray(inputs, dtype=np.float64))
+
+
+def test_pack_unpack_non_increasing():
+    inputs = [5, 1, 0, 999999, 2, 0, 0, 1 << 63, 42]
+    out = bytearray()
+    nbp.pack_non_increasing(inputs, out)
+    got, _ = nbp.unpack_to_words(bytes(out), 0, len(inputs))
+    assert got == inputs
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_roundtrip_increasing(seed):
+    # Mirrors the ScalaCheck property test over increasing long sequences
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 300))
+    deltas = rng.integers(0, 1 << 30, size=n)
+    values = np.cumsum(deltas).astype(np.int64)
+    out = bytearray()
+    nbp.pack_delta(values, out)
+    got, _ = nbp.unpack_delta(bytes(out), 0, n)
+    np.testing.assert_array_equal(got, values)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_property_roundtrip_doubles(seed):
+    rng = np.random.default_rng(seed + 100)
+    n = int(rng.integers(1, 257))
+    values = rng.normal(size=n) * (10.0 ** float(rng.integers(-3, 6)))
+    out = bytearray()
+    nbp.pack_doubles(values, out)
+    got, _ = nbp.unpack_double_xor(bytes(out), 0, n)
+    np.testing.assert_array_equal(got, values)
+
+
+def test_multiple_groups_chained():
+    # several groups of 8 back to back, ensures position chaining works
+    values = list(range(0, 64000, 1000))
+    out = bytearray()
+    nbp.pack_delta(values, out)
+    got, pos = nbp.unpack_delta(bytes(out), 0, len(values))
+    assert pos == len(out)
+    np.testing.assert_array_equal(got, values)

@@ -1,0 +1,112 @@
+"""Chunk vector codec tests (parity model: memory/src/test/.../ —
+EncodingPropertiesTest.scala round-trips, DoubleVectorTest counter cases)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.memory import histogram as bh
+from filodb_tpu.memory import vectors as bv
+
+
+def test_regular_timestamps_become_const():
+    ts = np.arange(0, 720_0000, 10_000, dtype=np.int64) + 1_600_000_000_000
+    buf = bv.encode_longs(ts)
+    kind, n = bv.parse_header(buf)
+    assert kind == bv.K_TS_CONST
+    assert n == ts.size
+    assert len(buf) == 21  # header + init + slope: perfectly regular collapses
+    np.testing.assert_array_equal(bv.decode_longs(buf), ts)
+
+
+def test_jittered_timestamps_roundtrip():
+    rng = np.random.default_rng(0)
+    ts = 1_600_000_000_000 + np.cumsum(rng.integers(9_000, 11_000, 500))
+    buf = bv.encode_longs(ts.astype(np.int64))
+    np.testing.assert_array_equal(bv.decode_longs(buf), ts)
+    # delta-delta should compress well: < 2.5 bytes/sample for jittered 10s data
+    assert len(buf) / ts.size < 2.5
+
+
+def test_doubles_roundtrip_and_const():
+    vals = np.array([3.0, 3.0, 3.0, 3.0])
+    buf = bv.encode_doubles(vals)
+    assert bv.parse_header(buf)[0] == bv.K_DOUBLE_CONST
+    np.testing.assert_array_equal(bv.decode_doubles(buf), vals)
+
+    rng = np.random.default_rng(1)
+    vals = rng.normal(100, 15, 300)
+    buf = bv.encode_doubles(vals)
+    np.testing.assert_array_equal(bv.decode_doubles(buf), vals)
+
+
+def test_integral_doubles_use_long_encoding():
+    vals = np.cumsum(np.ones(100)) * 5  # 5, 10, ... integral increasing
+    buf = bv.encode_doubles(vals, counter=True)
+    assert bv.parse_header(buf)[0] == bv.K_LONG_AS_DOUBLE
+    assert bv.is_counter_vector(buf)
+    np.testing.assert_array_equal(bv.decode_doubles(buf), vals)
+
+
+def test_nan_doubles_roundtrip():
+    vals = np.array([1.0, np.nan, 3.0, np.nan, 5.0])
+    buf = bv.encode_doubles(vals)
+    got = bv.decode_doubles(buf)
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(vals))
+    np.testing.assert_array_equal(got[~np.isnan(vals)], vals[~np.isnan(vals)])
+
+
+def test_counter_correction_detects_resets():
+    # counter goes up, resets to low value, continues
+    vals = np.array([10.0, 20, 30, 5, 15, 25, 2, 12])
+    corr = bv.counter_correction(vals)
+    corrected = vals + corr
+    # after first reset add 30, after second add 30+25
+    np.testing.assert_array_equal(
+        corrected, [10, 20, 30, 35, 45, 55, 57, 67])
+    assert np.all(np.diff(corrected) >= 0)
+
+
+def test_counter_correction_ignores_nans():
+    vals = np.array([10.0, np.nan, 30, 5])
+    corr = bv.counter_correction(vals)
+    assert corr[-1] == 30.0
+
+
+def test_histogram_2d_roundtrip():
+    scheme = bh.GeometricBuckets(2.0, 2.0, 8)
+    rng = np.random.default_rng(2)
+    incr = rng.integers(0, 50, size=(20, 8))
+    rows = np.cumsum(np.cumsum(incr, axis=0), axis=1)  # increasing in t & bucket
+    buf = bh.encode_histograms(scheme, rows)
+    got_scheme, counter, got = bh.decode_histograms(buf)
+    assert got_scheme == scheme
+    assert counter
+    np.testing.assert_array_equal(got, rows.astype(np.float64))
+
+
+def test_histogram_custom_buckets_roundtrip():
+    scheme = bh.CustomBuckets((0.5, 1.0, 2.5, 10.0, float("inf")))
+    rows = np.array([[1, 3, 5, 7, 9], [2, 4, 6, 9, 12]], dtype=np.int64)
+    buf = bh.encode_histograms(scheme, rows, counter=False)
+    got_scheme, counter, got = bh.decode_histograms(buf)
+    assert got_scheme.les().tolist()[:4] == [0.5, 1.0, 2.5, 10.0]
+    assert not counter
+    np.testing.assert_array_equal(got, rows)
+
+
+def test_histogram_reset_correction():
+    rows = np.array([[5, 10], [8, 16], [1, 2], [4, 8]], dtype=np.float64)
+    corr = bh.hist_counter_correction(rows)
+    corrected = rows + corr
+    np.testing.assert_array_equal(corrected[-1], [12, 24])
+
+
+def test_histogram_quantile_interpolation():
+    les = np.array([1.0, 2.0, 4.0, np.inf])
+    counts = np.array([0.0, 10.0, 10.0, 10.0])
+    # all observations fall in (1, 2]; median interpolates to 1.5
+    assert bh.quantile(0.5, les, counts) == pytest.approx(1.5)
+    # q=1 returns the upper bound of the bucket containing the last observation
+    assert bh.quantile(1.0, les, counts) == pytest.approx(2.0)
+    # empty histogram -> NaN
+    assert np.isnan(bh.quantile(0.5, les, np.zeros(4)))
