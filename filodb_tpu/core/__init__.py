@@ -1,0 +1,5 @@
+"""Core layer: schemas, record format, the time-series memstore, store APIs.
+
+TPU-native analogue of the reference's ``core/`` module
+(core/src/main/scala/filodb.core/*).
+"""
