@@ -1,0 +1,385 @@
+"""In-memory time-series store: shards, partitions, write buffers, chunks.
+
+TPU-native re-design of the reference's memstore
+(core/src/main/scala/filodb.core/memstore/TimeSeriesShard.scala:258,
+TimeSeriesPartition.scala:64, TimeSeriesMemStore.scala:26,
+WriteBufferPool.scala:34, store/ChunkSetInfo.scala:32).
+
+Key departures from the JVM design, chosen for the TPU execution model:
+
+- No off-heap Unsafe pointers: write buffers are plain Python/numpy appenders;
+  encoded chunks are immutable ``bytes`` (the interchange format from
+  filodb_tpu.memory.vectors).  The reference's ChunkMap spin-locks and
+  EvictionLock exist to let queries iterate shared mutable off-heap memory
+  safely; here queries only ever see **immutable published chunk lists** plus
+  a snapshot of the in-progress buffer tail, so the whole lock apparatus is
+  replaced by snapshot semantics (SURVEY.md §7 "immutable-snapshot design").
+
+- Flush groups (TimeSeriesShard.scala:1253 createFlushTasks): partitions hash
+  into ``num_groups`` subgroups; flushing a group encodes that group's write
+  buffers into chunks and records a checkpoint offset, exactly like the
+  reference's interleaved flush/ingest protocol, minus the actor machinery.
+
+- Queries hitting recent data merge the encoded chunks with the current
+  write-buffer snapshot (the reference reads write buffers through the same
+  BinaryVector API; here the tail is just small host arrays appended to the
+  decoded chunk arrays).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.index import (END_TIME_INGESTING, ColumnFilter, TagIndex)
+from filodb_tpu.core.record import PartKey, RecordContainer
+from filodb_tpu.core.schemas import (ColumnType, DataSchema, DatasetRef,
+                                     Schemas)
+from filodb_tpu.memory import histogram as bh
+from filodb_tpu.memory import vectors as bv
+
+DEFAULT_MAX_CHUNK_ROWS = 400  # store config max-chunks-size (IngestionConfig)
+
+
+def chunk_id(start_ts: int, seq: int) -> int:
+    """chunkID = startTime << 12 | seq (core/store/package.scala chunkID)."""
+    return (start_ts << 12) | (seq & 0xFFF)
+
+
+@dataclass
+class ChunkSetInfo:
+    """Per-chunk metadata (store/ChunkSetInfo.scala:32 — 32-byte metadata:
+    id, numRows, startTime, endTime + per-column vector ptrs)."""
+    id: int
+    num_rows: int
+    start_ts: int
+    end_ts: int
+    vectors: Tuple[bytes, ...]  # column 0 = timestamps
+
+    def decode_column(self, i: int):
+        return bv.decode(self.vectors[i]) if i == 0 or not _is_hist(
+            self.vectors[i]) else bh.decode_histograms(self.vectors[i])
+
+
+def _is_hist(buf: bytes) -> bool:
+    return buf[:1] == bytes([bh.K_HIST_2D])
+
+
+class TimeSeriesPartition:
+    """One time series in one shard (memstore/TimeSeriesPartition.scala:64).
+
+    Write path: ``ingest`` appends to the current write buffer; when the
+    buffer reaches ``max_chunk_rows`` (or on flush-group flush) the buffer is
+    encoded to an immutable chunk (``encodeOneChunkset`` :248 equivalent) and
+    published to ``chunks``."""
+
+    __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
+                 "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
+                 "ingested", "ooo_dropped")
+
+    def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
+                 max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
+        self.part_id = part_id
+        self.part_key = part_key
+        self.schema = schema
+        self.chunks: List[ChunkSetInfo] = []
+        self._ts_buf: List[int] = []
+        self._col_bufs: List[List] = [[] for _ in schema.data_columns]
+        self._hist_scheme = None
+        self.max_chunk_rows = max_chunk_rows
+        self._chunk_seq = 0
+        self.ingested = 0
+        self.ooo_dropped = 0
+
+    # -- write path -------------------------------------------------------
+    def ingest(self, timestamp: int, values: Sequence) -> bool:
+        """Append one row.  Out-of-order / duplicate timestamps within the
+        partition are dropped (TimeSeriesPartition.scala ingest OOO rules).
+        Returns True if ingested."""
+        last = self.last_timestamp
+        if last is not None and timestamp <= last:
+            self.ooo_dropped += 1
+            return False
+        self._ts_buf.append(int(timestamp))
+        for buf, col, v in zip(self._col_bufs, self.schema.data_columns, values):
+            if col.col_type == ColumnType.HISTOGRAM:
+                scheme, counts = v
+                if self._hist_scheme is None:
+                    self._hist_scheme = scheme
+                buf.append(np.asarray(counts, dtype=np.int64))
+            else:
+                buf.append(float(v))
+        self.ingested += 1
+        if len(self._ts_buf) >= self.max_chunk_rows:
+            self.switch_buffers()
+        return True
+
+    @property
+    def last_timestamp(self) -> Optional[int]:
+        if self._ts_buf:
+            return self._ts_buf[-1]
+        if self.chunks:
+            return self.chunks[-1].end_ts
+        return None
+
+    @property
+    def earliest_timestamp(self) -> Optional[int]:
+        if self.chunks:
+            return self.chunks[0].start_ts
+        return self._ts_buf[0] if self._ts_buf else None
+
+    def switch_buffers(self) -> Optional[ChunkSetInfo]:
+        """Encode the current write buffer into an immutable chunk
+        (TimeSeriesPartition.scala:229 switchBuffers / :248 encodeOneChunkset).
+        """
+        if not self._ts_buf:
+            return None
+        ts = np.asarray(self._ts_buf, dtype=np.int64)
+        vecs: List[bytes] = [bv.encode_longs(ts)]
+        for buf, col in zip(self._col_bufs, self.schema.data_columns):
+            if col.col_type == ColumnType.HISTOGRAM:
+                rows = np.stack(buf) if buf else np.zeros((0, 0), np.int64)
+                vecs.append(bh.encode_histograms(
+                    self._hist_scheme, rows, counter=col.counter))
+            else:
+                vecs.append(bv.encode_doubles(
+                    np.asarray(buf, dtype=np.float64),
+                    counter=col.detect_drops))
+        info = ChunkSetInfo(
+            id=chunk_id(int(ts[0]), self._chunk_seq),
+            num_rows=ts.size,
+            start_ts=int(ts[0]),
+            end_ts=int(ts[-1]),
+            vectors=tuple(vecs),
+        )
+        self._chunk_seq += 1
+        self.chunks.append(info)
+        self._ts_buf = []
+        self._col_bufs = [[] for _ in self.schema.data_columns]
+        return info
+
+    # -- read path --------------------------------------------------------
+    def buffer_snapshot(self):
+        """Snapshot of the un-encoded tail (timestamps, per-column lists)."""
+        return (np.asarray(self._ts_buf, dtype=np.int64),
+                [list(b) for b in self._col_bufs])
+
+    def read_range(self, start_ts: int, end_ts: int, col_index: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """All samples with start_ts <= t <= end_ts for one data column.
+        Returns (timestamps int64, values f64 or [n, nb] f64 for histograms).
+
+        Merges immutable chunks with the current write-buffer snapshot — the
+        equivalent of the reference's RawDataRangeVector iteration over
+        ChunkMap + appenders (TimeSeriesPartition readers)."""
+        col = self.schema.columns[col_index]
+        ts_parts: List[np.ndarray] = []
+        val_parts: List[np.ndarray] = []
+        for c in self.chunks:
+            if c.end_ts < start_ts or c.start_ts > end_ts:
+                continue
+            ts = bv.decode_longs(c.vectors[0])
+            if col.col_type == ColumnType.HISTOGRAM:
+                _, _, vals = bh.decode_histograms(c.vectors[col_index])
+            else:
+                vals = bv.decode_doubles(c.vectors[col_index])
+            ts_parts.append(ts)
+            val_parts.append(vals)
+        buf_ts, buf_cols = self.buffer_snapshot()
+        if buf_ts.size:
+            ts_parts.append(buf_ts)
+            if col.col_type == ColumnType.HISTOGRAM:
+                rows = buf_cols[col_index - 1]
+                val_parts.append(
+                    np.stack(rows).astype(np.float64) if rows
+                    else np.zeros((0, 0)))
+            else:
+                val_parts.append(
+                    np.asarray(buf_cols[col_index - 1], dtype=np.float64))
+        if not ts_parts:
+            nb = 0
+            empty_vals = (np.zeros((0, nb)) if col.col_type ==
+                          ColumnType.HISTOGRAM else np.zeros(0))
+            return np.zeros(0, dtype=np.int64), empty_vals
+        ts_all = np.concatenate(ts_parts)
+        val_all = np.concatenate(val_parts, axis=0)
+        m = (ts_all >= start_ts) & (ts_all <= end_ts)
+        return ts_all[m], val_all[m]
+
+    @property
+    def num_chunks(self) -> int:
+        return len(self.chunks)
+
+
+@dataclass
+class ShardStats:
+    """Kamon-equivalent gauges (TimeSeriesShardStats, TimeSeriesShard.scala:41).
+    """
+    rows_ingested: int = 0
+    rows_skipped: int = 0
+    out_of_order_dropped: int = 0
+    num_series: int = 0
+    chunks_encoded: int = 0
+    encoded_bytes: int = 0
+    flushes_done: int = 0
+    partitions_evicted: int = 0
+
+
+class TimeSeriesShard:
+    """One shard: partKey -> partition map + tag index + flush groups
+    (memstore/TimeSeriesShard.scala:258)."""
+
+    def __init__(self, ref: DatasetRef, schemas: Schemas, shard_num: int,
+                 num_groups: int = 8,
+                 max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
+                 max_series: int = 1_000_000):
+        self.ref = ref
+        self.schemas = schemas
+        self.shard_num = shard_num
+        self.num_groups = num_groups
+        self.max_chunk_rows = max_chunk_rows
+        self.max_series = max_series  # cardinality quota (ratelimit/)
+        self.partitions: Dict[int, TimeSeriesPartition] = {}
+        self._by_part_key: Dict[bytes, int] = {}
+        self._next_part_id = 0
+        self.index = TagIndex()
+        self.stats = ShardStats()
+        # per-group ingestion checkpoint offsets (CheckpointTable semantics)
+        self.checkpoints: Dict[int, int] = {}
+
+    # -- ingest path ------------------------------------------------------
+    def get_or_create_partition(self, part_key: PartKey, first_ts: int
+                                ) -> Optional[TimeSeriesPartition]:
+        """(TimeSeriesShard.scala:960 getOrAddPartitionForIngestion)."""
+        kb = part_key.to_bytes()
+        pid = self._by_part_key.get(kb)
+        if pid is not None:
+            return self.partitions[pid]
+        if len(self.partitions) >= self.max_series:
+            # quota breach: drop new series (ratelimit/CardinalityTracker)
+            return None
+        schema = self.schemas.by_id(part_key.schema_id)
+        pid = self._next_part_id
+        self._next_part_id += 1
+        part = TimeSeriesPartition(pid, part_key, schema, self.max_chunk_rows)
+        self.partitions[pid] = part
+        self._by_part_key[kb] = pid
+        self.index.add_part_key(pid, part_key.label_map, first_ts)
+        self.stats.num_series = len(self.partitions)
+        return part
+
+    def ingest(self, container: RecordContainer, offset: int = -1) -> int:
+        """Ingest one record container (TimeSeriesShard.scala:871).
+        Returns number of rows ingested."""
+        n = 0
+        for row in container.rows():
+            part = self.get_or_create_partition(row.part_key, row.timestamp)
+            if part is None:
+                self.stats.rows_skipped += 1
+                continue
+            if part.ingest(row.timestamp, row.values):
+                n += 1
+                self.index.update_end_time(part.part_id, row.timestamp)
+            else:
+                self.stats.out_of_order_dropped += 1
+        self.stats.rows_ingested += n
+        if offset >= 0:
+            # conservative: record offset against all groups on explicit flush
+            self._last_offset = offset
+        return n
+
+    def group_of(self, part_id: int) -> int:
+        return part_id % self.num_groups
+
+    def flush_group(self, group: int, offset: int = -1) -> int:
+        """Encode write buffers of one flush group
+        (TimeSeriesShard.scala:1341 doFlushSteps).  Returns chunks written."""
+        n = 0
+        for pid, part in self.partitions.items():
+            if pid % self.num_groups != group:
+                continue
+            info = part.switch_buffers()
+            if info is not None:
+                n += 1
+                self.stats.chunks_encoded += 1
+                self.stats.encoded_bytes += sum(len(v) for v in info.vectors)
+        self.stats.flushes_done += 1
+        if offset >= 0:
+            self.checkpoints[group] = offset
+        return n
+
+    def flush_all(self, offset: int = -1) -> int:
+        return sum(self.flush_group(g, offset) for g in range(self.num_groups))
+
+    def recovery_watermark(self) -> int:
+        """min checkpoint over groups — replay start offset
+        (IngestionActor.scala:297 doRecovery)."""
+        if len(self.checkpoints) < self.num_groups:
+            return -1
+        return min(self.checkpoints.values())
+
+    # -- read path --------------------------------------------------------
+    def lookup_partitions(self, filters: Sequence[ColumnFilter],
+                          start_ts: int, end_ts: int
+                          ) -> List[TimeSeriesPartition]:
+        """(memstore lookupPartitions via the tag index)."""
+        pids = self.index.part_ids_from_filters(filters, start_ts, end_ts)
+        return [self.partitions[p] for p in pids]
+
+    # -- eviction ---------------------------------------------------------
+    def evict_partitions(self, cutoff_ts: int) -> int:
+        """Evict series whose data ended before cutoff
+        (PartitionEvictionPolicy / EvictablePartIdQueueSet equivalents)."""
+        evict = [
+            pid for pid, p in self.partitions.items()
+            if (p.last_timestamp is not None and p.last_timestamp < cutoff_ts
+                and not p._ts_buf)
+        ]
+        for pid in evict:
+            part = self.partitions.pop(pid)
+            self._by_part_key.pop(part.part_key.to_bytes(), None)
+        self.index.remove_part_keys(evict)
+        self.stats.partitions_evicted += len(evict)
+        self.stats.num_series = len(self.partitions)
+        return len(evict)
+
+
+class TimeSeriesMemStore:
+    """Top-level store: dataset -> shards (memstore/TimeSeriesMemStore.scala:26).
+    """
+
+    def __init__(self, schemas: Optional[Schemas] = None):
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        self.schemas = schemas or DEFAULT_SCHEMAS
+        self._shards: Dict[DatasetRef, Dict[int, TimeSeriesShard]] = {}
+
+    def setup(self, ref: DatasetRef, shard_num: int, num_groups: int = 8,
+              max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS) -> TimeSeriesShard:
+        shards = self._shards.setdefault(ref, {})
+        if shard_num in shards:
+            raise ValueError(f"shard {shard_num} already set up for {ref}")
+        shard = TimeSeriesShard(ref, self.schemas, shard_num, num_groups,
+                                max_chunk_rows)
+        shards[shard_num] = shard
+        return shard
+
+    def get_shard(self, ref: DatasetRef, shard_num: int) -> TimeSeriesShard:
+        return self._shards[ref][shard_num]
+
+    def shards(self, ref: DatasetRef) -> List[TimeSeriesShard]:
+        return [s for _, s in sorted(self._shards.get(ref, {}).items())]
+
+    def ingest(self, ref: DatasetRef, shard_num: int,
+               container: RecordContainer, offset: int = -1) -> int:
+        return self.get_shard(ref, shard_num).ingest(container, offset)
+
+    def flush_all(self, ref: DatasetRef) -> int:
+        return sum(s.flush_all() for s in self.shards(ref))
+
+    def lookup_partitions(self, ref: DatasetRef, shard_num: int,
+                          filters: Sequence[ColumnFilter],
+                          start_ts: int, end_ts: int):
+        return self.get_shard(ref, shard_num).lookup_partitions(
+            filters, start_ts, end_ts)
