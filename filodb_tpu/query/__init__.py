@@ -1,0 +1,11 @@
+"""Query engine: LogicalPlan -> ExecPlan -> windowed range functions and
+aggregations, with a numpy oracle backend and a JAX/TPU backend.
+
+TPU-native analogue of the reference's ``query/`` module
+(query/src/main/scala/filodb/query/*).  The central design change: instead of
+row-at-a-time iterators (ChunkedWindowIterator hot loop,
+query/exec/PeriodicSamplesMapper.scala:223), series are materialized into
+dense ``[num_series, num_samples]`` tiles and every range function is a
+vectorized computation over per-window index ranges — `searchsorted` +
+cumulative-sum algebra — which maps directly onto the TPU VPU/MXU.
+"""
