@@ -1,0 +1,6 @@
+"""PromQL frontend: parser producing LogicalPlans
+(reference: prometheus/src/main/scala/filodb/prometheus/parse/Parser.scala:183,
+ast/*.scala; grammar prometheus/src/main/java/filodb/prometheus/antlr/PromQL.g4).
+"""
+
+from filodb_tpu.promql.parser import parse_query, parse_query_range  # noqa: F401

@@ -1,0 +1,884 @@
+"""Query execution engine (numpy oracle backend).
+
+Materializes and evaluates LogicalPlans against a set of memstore shards.
+This is the single-process analogue of the reference's ExecPlan pipeline
+(query/exec/ExecPlan.scala:46, SelectRawPartitionsExec.scala:159,
+PeriodicSamplesMapper.scala:61, AggrOverRangeVectors.scala:98,193,
+BinaryJoinExec.scala:58, InstantVectorFunctionMapper, ScalarOperationMapper)
+— re-shaped around dense [series, steps] grids instead of row iterators.
+
+Every numeric here defines the oracle the TPU backend
+(filodb_tpu.query.tpu) must match bit-for-bit modulo float tolerance.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.schemas import ColumnType
+from filodb_tpu.memory import histogram as bh
+from filodb_tpu.memory.vectors import counter_correction
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query import rangefn as rf
+from filodb_tpu.query.model import (GridResult, QueryError, QueryStats,
+                                    RangeParams, RawSeries, ScalarResult)
+
+METRIC_LABELS = ("_metric_", "__name__")
+
+
+def strip_metric(labels: Mapping[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in labels.items() if k not in METRIC_LABELS}
+
+
+# ---------------------------------------------------------------------------
+# Raw data selection (SelectRawPartitionsExec)
+# ---------------------------------------------------------------------------
+
+def select_raw_series(shards: Sequence[TimeSeriesShard],
+                      filters: Sequence[ColumnFilter],
+                      start_ms: int, end_ms: int,
+                      column: Optional[str] = None,
+                      stats: Optional[QueryStats] = None) -> List[RawSeries]:
+    """Gather raw samples for all matching series across shards
+    (SelectRawPartitionsExec.scala:159 doExecute; schema resolved per
+    partition like MultiSchemaPartitionsExec)."""
+    out: List[RawSeries] = []
+    for shard in shards:
+        for part in shard.lookup_partitions(filters, start_ms, end_ms):
+            schema = part.schema
+            col_name = column or schema.value_column
+            try:
+                ci = [c.name for c in schema.columns].index(col_name)
+            except ValueError:
+                raise QueryError(
+                    f"schema {schema.name} has no column {col_name}")
+            col = schema.columns[ci]
+            ts, vals = part.read_range(start_ms, end_ms, ci)
+            les = None
+            if col.col_type == ColumnType.HISTOGRAM:
+                les = part._hist_scheme.les() if part._hist_scheme is not None \
+                    else None
+            out.append(RawSeries(
+                labels=dict(part.part_key.labels),
+                ts=ts, values=vals,
+                is_counter=col.is_counter_like,
+                bucket_les=les,
+            ))
+            if stats is not None:
+                stats.series_scanned += 1
+                stats.samples_scanned += int(ts.size)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Periodic sampling / windowing (PeriodicSamplesMapper)
+# ---------------------------------------------------------------------------
+
+def periodic_samples(series: Sequence[RawSeries], params: RangeParams,
+                     function: Optional[str], window_ms: int,
+                     func_args: Sequence[float] = (),
+                     offset_ms: int = 0) -> GridResult:
+    """Apply a range function (or lookback last-sample) per series onto the
+    step grid (exec/PeriodicSamplesMapper.scala:61; ChunkedWindowIterator
+    :223 hot loop, vectorized)."""
+    steps = params.steps
+    wend = steps - offset_ms
+    wstart = wend - window_ms
+    func = function or "last_sample"
+    s1 = func_args[0] if len(func_args) > 0 else None
+    s2 = func_args[1] if len(func_args) > 1 else None
+
+    keys: List[Dict[str, str]] = []
+    rows: List[np.ndarray] = []
+    hist_rows: List[np.ndarray] = []
+    les = None
+    any_hist = False
+    for s in series:
+        if s.values.ndim == 2:
+            any_hist = True
+            break
+
+    if not any_hist:
+        fn = rf.RANGE_FUNCTIONS.get(func)
+        if fn is None:
+            raise QueryError(f"unknown range function {func}")
+        for s in series:
+            keys.append(dict(s.labels))
+            rows.append(fn(s.ts, s.values, wstart, wend,
+                           scalar=s1, scalar2=s2))
+        values = np.vstack(rows) if rows else np.zeros((0, steps.size))
+        return GridResult(steps, keys, values)
+
+    # histogram path: apply per bucket (HistogramRateFunctionBase,
+    # RateFunctions.scala:249; SumOverTimeChunkedFunctionH)
+    for s in series:
+        keys.append(dict(s.labels))
+        if s.values.ndim != 2:
+            raise QueryError("mixed histogram/double inputs")
+        les = s.bucket_les if s.bucket_les is not None else les
+        hist_rows.append(_hist_window(s, func, wstart, wend))
+    if hist_rows:
+        nb = max(h.shape[1] for h in hist_rows)
+        hist_rows = [h if h.shape[1] == nb else
+                     np.pad(h, ((0, 0), (0, nb - h.shape[1]), (0, 0)),
+                            constant_values=np.nan)
+                     for h in hist_rows]
+    hv = np.stack(hist_rows) if hist_rows else np.zeros((0, 0, steps.size))
+    hv = np.transpose(hv, (0, 2, 1))  # [S, T, NB]
+    return GridResult(steps, keys, np.full((len(keys), steps.size), np.nan),
+                      hist_values=hv, bucket_les=les)
+
+
+def _hist_window(s: RawSeries, func: str, wstart, wend) -> np.ndarray:
+    """Evaluate a range function over a histogram series, per bucket.
+    Returns [NB, T]."""
+    ts = s.ts
+    mat = s.values  # [n, nb]
+    nb = mat.shape[1] if mat.size else 0
+    if func in ("rate", "increase"):
+        corrected = mat + bh.hist_counter_correction(mat) if s.is_counter \
+            else mat
+        out = np.empty((nb, wstart.size))
+        lo, hi = rf.window_bounds(ts, wstart, wend)
+        counts = hi - lo + 1
+        lo_c = np.clip(lo, 0, max(ts.size - 1, 0))
+        hi_c = np.clip(hi, 0, max(ts.size - 1, 0))
+        for b in range(nb):
+            if ts.size == 0:
+                out[b] = np.nan
+                continue
+            out[b] = rf.extrapolated_rate(
+                wstart, wend, counts,
+                ts[lo_c], corrected[lo_c, b], ts[hi_c], corrected[hi_c, b],
+                True, func == "rate")
+        return out
+    if func in ("sum_over_time", "rate_over_delta", "increase_over_delta"):
+        out = np.empty((nb, wstart.size))
+        for b in range(nb):
+            out[b] = rf.RANGE_FUNCTIONS[
+                "sum_over_time" if func != "rate_over_delta" else
+                "rate_over_delta"](ts, mat[:, b], wstart, wend)
+        return out
+    if func == "last_sample":
+        out = np.empty((nb, wstart.size))
+        for b in range(nb):
+            out[b] = rf.RANGE_FUNCTIONS["last_sample"](
+                ts, mat[:, b], wstart, wend)
+        return out
+    raise QueryError(f"range function {func} unsupported for histograms")
+
+
+# ---------------------------------------------------------------------------
+# Aggregations across series (RowAggregator / AggregateMapReduce)
+# ---------------------------------------------------------------------------
+
+def _group_keys(keys: List[Dict[str, str]], by: Tuple[str, ...],
+                without: Tuple[str, ...]):
+    """Group index per series (AggregateMapReduce grouping,
+    AggrOverRangeVectors.scala:98)."""
+    gids: List[int] = []
+    gkeys: List[Dict[str, str]] = []
+    seen: Dict[Tuple, int] = {}
+    for k in keys:
+        k2 = strip_metric(k)
+        if by:
+            gk = {l: k2[l] for l in by if l in k2}
+        elif without:
+            gk = {l: v for l, v in k2.items() if l not in without}
+        else:
+            gk = {}
+        key = tuple(sorted(gk.items()))
+        gid = seen.setdefault(key, len(seen))
+        if gid == len(gkeys):
+            gkeys.append(gk)
+        gids.append(gid)
+    return np.array(gids, dtype=np.int64), gkeys
+
+
+def aggregate(grid: GridResult, op: str, params: Tuple = (),
+              by: Tuple[str, ...] = (), without: Tuple[str, ...] = ()
+              ) -> GridResult:
+    """Cross-series aggregation on the grid
+    (exec/aggregator/*.scala map-reduce-present protocol)."""
+    if grid.is_hist() and op == "sum":
+        return _aggregate_hist_sum(grid, by, without)
+    v = grid.values  # [S, T]
+    steps = grid.steps
+    if grid.num_series == 0:
+        return GridResult(steps, [], np.zeros((0, steps.size)))
+    gids, gkeys = _group_keys(grid.keys, tuple(by), tuple(without))
+    ng = len(gkeys)
+    T = steps.size
+    present = ~np.isnan(v)
+    vz = np.where(present, v, 0.0)
+
+    def seg(arr):  # segment sum over groups
+        out = np.zeros((ng, T))
+        np.add.at(out, gids, arr)
+        return out
+
+    cnt = seg(present.astype(np.float64))
+    none = cnt == 0
+    with np.errstate(invalid="ignore", divide="ignore"):
+        if op == "sum":
+            out = seg(vz)
+        elif op == "count":
+            out = cnt
+        elif op == "avg":
+            out = seg(vz) / cnt
+        elif op == "group":
+            out = np.ones((ng, T))
+        elif op in ("min", "max"):
+            fill = np.inf if op == "min" else -np.inf
+            vf = np.where(present, v, fill)
+            out = np.full((ng, T), fill)
+            ufunc = np.minimum if op == "min" else np.maximum
+            ufunc.at(out, gids, vf)
+            out = np.where(np.isinf(out), np.nan, out)
+        elif op in ("stddev", "stdvar"):
+            s = seg(vz)
+            s2 = seg(vz * vz)
+            mean = s / cnt
+            var = np.maximum(s2 / cnt - mean * mean, 0.0)
+            out = var if op == "stdvar" else np.sqrt(var)
+        elif op in ("topk", "bottomk"):
+            return _topk(grid, int(params[0]), gids, gkeys,
+                         bottom=(op == "bottomk"))
+        elif op == "quantile":
+            q = float(params[0])
+            out = np.full((ng, T), np.nan)
+            for g in range(ng):
+                sel = v[gids == g]  # [Sg, T]
+                with np.errstate(all="ignore"):
+                    out[g] = np.nanquantile(sel, min(max(q, 0), 1), axis=0) \
+                        if 0 <= q <= 1 else (np.inf if q > 1 else -np.inf)
+        elif op == "count_values":
+            return _count_values(grid, str(params[0]), gids, gkeys)
+        elif op == "absent":
+            out = np.where(cnt == 0, 1.0, np.nan)
+            none = np.zeros_like(none)
+        else:
+            raise QueryError(f"unknown aggregation op {op}")
+    out = np.where(none, np.nan, out)
+    return GridResult(steps, gkeys, out)
+
+
+def _aggregate_hist_sum(grid: GridResult, by, without) -> GridResult:
+    gids, gkeys = _group_keys(grid.keys, tuple(by), tuple(without))
+    ng = len(gkeys)
+    hv = grid.hist_values  # [S, T, NB]
+    present = ~np.isnan(hv)
+    out = np.zeros((ng,) + hv.shape[1:])
+    np.add.at(out, gids, np.where(present, hv, 0.0))
+    cnt = np.zeros((ng,) + hv.shape[1:])
+    np.add.at(cnt, gids, present.astype(np.float64))
+    out = np.where(cnt == 0, np.nan, out)
+    return GridResult(grid.steps, gkeys,
+                      np.full((ng, grid.steps.size), np.nan),
+                      hist_values=out, bucket_les=grid.bucket_les)
+
+
+def _topk(grid: GridResult, k: int, gids, gkeys, bottom: bool) -> GridResult:
+    """topk/bottomk: per step, keep k best series per group; output is the
+    union of selected series with NaN elsewhere (TopBottomK aggregator)."""
+    v = grid.values
+    S, T = v.shape
+    out_rows: List[np.ndarray] = []
+    out_keys: List[Dict[str, str]] = []
+    for g in range(len(gkeys)):
+        idx = np.where(gids == g)[0]
+        sub = v[idx]  # [Sg, T]
+        score = np.where(np.isnan(sub), -np.inf if not bottom else np.inf, sub)
+        order = np.argsort(-score if not bottom else score, axis=0,
+                           kind="stable")
+        keep = np.zeros_like(sub, dtype=bool)
+        kk = min(k, sub.shape[0])
+        cols = np.arange(T)
+        for r in range(kk):
+            keep[order[r], cols] = True
+        keep &= ~np.isnan(sub)
+        for i, si in enumerate(idx):
+            if keep[i].any():
+                out_keys.append(dict(grid.keys[si]))
+                out_rows.append(np.where(keep[i], sub[i], np.nan))
+    values = np.vstack(out_rows) if out_rows else np.zeros((0, T))
+    return GridResult(grid.steps, out_keys, values)
+
+
+def _count_values(grid: GridResult, label: str, gids, gkeys) -> GridResult:
+    v = grid.values
+    T = grid.steps.size
+    buckets: Dict[Tuple[int, str], np.ndarray] = {}
+    for s in range(v.shape[0]):
+        g = gids[s]
+        for t in range(T):
+            x = v[s, t]
+            if np.isnan(x):
+                continue
+            key = (g, repr(float(x)) if x != int(x) else str(int(x)))
+            row = buckets.setdefault(key, np.zeros(T))
+            row[t] += 1
+    keys_out: List[Dict[str, str]] = []
+    rows = []
+    for (g, val), row in sorted(buckets.items(), key=lambda kv: kv[0][1]):
+        k = dict(gkeys[g])
+        k[label] = val
+        keys_out.append(k)
+        rows.append(np.where(row == 0, np.nan, row))
+    values = np.vstack(rows) if rows else np.zeros((0, T))
+    return GridResult(grid.steps, keys_out, values)
+
+
+# ---------------------------------------------------------------------------
+# Binary operations (BinaryJoinExec, SetOperatorExec, ScalarOperationMapper)
+# ---------------------------------------------------------------------------
+
+_ARITH = {
+    "+": np.add, "-": np.subtract, "*": np.multiply,
+    "/": np.divide, "^": np.power,
+}
+_COMP = {
+    "==": np.equal, "!=": np.not_equal, ">": np.greater,
+    "<": np.less, ">=": np.greater_equal, "<=": np.less_equal,
+}
+
+
+def _apply_op(op: str, a, b, return_bool: bool):
+    with np.errstate(all="ignore"):
+        if op in _ARITH:
+            return _ARITH[op](a, b)
+        if op == "%":
+            return np.fmod(a, b)
+        if op == "atan2":
+            return np.arctan2(a, b)
+        if op in _COMP:
+            m = _COMP[op](a, b)
+            if return_bool:
+                out = m.astype(np.float64)
+                nan = np.isnan(a) | np.isnan(b)
+                return np.where(nan, np.nan, out)
+            return np.where(m, a, np.nan)
+    raise QueryError(f"unknown binary op {op}")
+
+
+def scalar_vector_op(grid: GridResult, scalar, op: str, scalar_is_lhs: bool,
+                     return_bool: bool = False) -> GridResult:
+    """(exec/RangeVectorTransformer.scala:201 ScalarOperationMapper)."""
+    sv = scalar.values if isinstance(scalar, ScalarResult) else scalar
+    a, b = (sv, grid.values) if scalar_is_lhs else (grid.values, sv)
+    out = _apply_op(op, a, b, return_bool)
+    keys = [strip_metric(k) for k in grid.keys]
+    return GridResult(grid.steps, keys, out)
+
+
+def _join_key(labels: Mapping[str, str], on: Optional[Tuple[str, ...]],
+              ignoring: Tuple[str, ...]) -> Tuple:
+    l2 = strip_metric(labels)
+    if on is not None:
+        return tuple(sorted((k, v) for k, v in l2.items() if k in on))
+    return tuple(sorted((k, v) for k, v in l2.items() if k not in ignoring))
+
+
+def binary_join(lhs: GridResult, rhs: GridResult, op: str,
+                cardinality: str = "one-to-one",
+                on: Optional[Tuple[str, ...]] = None,
+                ignoring: Tuple[str, ...] = (),
+                include: Tuple[str, ...] = (),
+                return_bool: bool = False) -> GridResult:
+    """Vector-vector binary operation with label matching
+    (exec/BinaryJoinExec.scala:58; set ops SetOperatorExec.scala:32)."""
+    steps = lhs.steps
+    if op in ("and", "or", "unless"):
+        return _set_op(lhs, rhs, op, on, ignoring)
+
+    # determine "one" side for many-to-one/one-to-many
+    if cardinality == "one-to-many":
+        # mirror: swap so the many side is lhs, then swap op operand order
+        swapped = binary_join(rhs, lhs, _swap_op(op), "many-to-one", on,
+                              ignoring, include, return_bool)
+        return swapped
+
+    rmap: Dict[Tuple, List[int]] = {}
+    for j, k in enumerate(rhs.keys):
+        rmap.setdefault(_join_key(k, on, ignoring), []).append(j)
+    if cardinality == "one-to-one":
+        for key, js in rmap.items():
+            if len(js) > 1:
+                raise QueryError(
+                    "many-to-many join: duplicate series on right side")
+    out_keys: List[Dict[str, str]] = []
+    rows: List[np.ndarray] = []
+    seen_left: Dict[Tuple, int] = {}
+    for i, k in enumerate(lhs.keys):
+        key = _join_key(k, on, ignoring)
+        js = rmap.get(key)
+        if not js:
+            continue
+        if cardinality == "one-to-one":
+            if key in seen_left:
+                raise QueryError(
+                    "many-to-many join: duplicate series on left side")
+            seen_left[key] = i
+        j = js[0]
+        a, b = lhs.values[i], rhs.values[j]
+        out = _apply_op(op, a, b, return_bool)
+        labels = strip_metric(k) if not return_bool else strip_metric(k)
+        if cardinality == "many-to-one" and include:
+            for l in include:
+                if l in rhs.keys[j]:
+                    labels = dict(labels)
+                    labels[l] = rhs.keys[j][l]
+        rows.append(out)
+        out_keys.append(dict(labels))
+    values = np.vstack(rows) if rows else np.zeros((0, steps.size))
+    return GridResult(steps, out_keys, values)
+
+
+def _swap_op(op: str) -> str:
+    swaps = {">": "<", "<": ">", ">=": "<=", "<=": ">=", "-": "-", "/": "/"}
+    # for commutative ops the same op works; for - and / we must NOT swap
+    # operands blindly — handled by caller semantics; keep simple:
+    return {">": "<", "<": ">", ">=": "<=", "<=": ">="}.get(op, op)
+
+
+def _set_op(lhs: GridResult, rhs: GridResult, op: str,
+            on: Optional[Tuple[str, ...]], ignoring: Tuple[str, ...]
+            ) -> GridResult:
+    rkeys = {_join_key(k, on, ignoring): j for j, k in enumerate(rhs.keys)}
+    steps = lhs.steps
+    keys_out: List[Dict[str, str]] = []
+    rows: List[np.ndarray] = []
+    if op == "and":
+        for i, k in enumerate(lhs.keys):
+            j = rkeys.get(_join_key(k, on, ignoring))
+            if j is None:
+                continue
+            mask = ~np.isnan(rhs.values[j])
+            keys_out.append(dict(k))
+            rows.append(np.where(mask, lhs.values[i], np.nan))
+    elif op == "unless":
+        for i, k in enumerate(lhs.keys):
+            j = rkeys.get(_join_key(k, on, ignoring))
+            row = lhs.values[i]
+            if j is not None:
+                row = np.where(np.isnan(rhs.values[j]), row, np.nan)
+            keys_out.append(dict(k))
+            rows.append(row)
+    elif op == "or":
+        lkeys = set()
+        for i, k in enumerate(lhs.keys):
+            lkeys.add(_join_key(k, on, ignoring))
+            keys_out.append(dict(k))
+            rows.append(lhs.values[i])
+        for j, k in enumerate(rhs.keys):
+            if _join_key(k, on, ignoring) not in lkeys:
+                keys_out.append(dict(k))
+                rows.append(rhs.values[j])
+    values = np.vstack(rows) if rows else np.zeros((0, steps.size))
+    return GridResult(steps, keys_out, values)
+
+
+# ---------------------------------------------------------------------------
+# Instant functions (rangefn/InstantFunction.scala)
+# ---------------------------------------------------------------------------
+
+_INSTANT_UNARY = {
+    "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
+    "ln": np.log, "log2": np.log2, "log10": np.log10, "sqrt": np.sqrt,
+    "round": None, "sgn": np.sign,
+    "acos": np.arccos, "asin": np.arcsin, "atan": np.arctan, "cos": np.cos,
+    "cosh": np.cosh, "sin": np.sin, "sinh": np.sinh, "tan": np.tan,
+    "tanh": np.tanh, "deg": np.degrees, "rad": np.radians,
+}
+
+
+def instant_function(grid: GridResult, func: str,
+                     args: Sequence[float] = ()) -> GridResult:
+    """(exec/RangeVectorTransformer.scala:62 InstantVectorFunctionMapper)."""
+    keys = [strip_metric(k) for k in grid.keys]
+    with np.errstate(all="ignore"):
+        if func == "histogram_quantile":
+            return histogram_quantile(grid, float(args[0]))
+        if func == "histogram_bucket":
+            return histogram_bucket(grid, float(args[0]))
+        if func == "histogram_max_quantile":
+            return histogram_quantile(grid, float(args[0]))
+        if func in _INSTANT_UNARY:
+            if func == "round":
+                to_nearest = float(args[0]) if args else 1.0
+                out = np.floor(grid.values / to_nearest + 0.5) * to_nearest
+            else:
+                out = _INSTANT_UNARY[func](grid.values)
+            return GridResult(grid.steps, keys, out)
+        if func == "clamp":
+            out = np.clip(grid.values, float(args[0]), float(args[1]))
+            return GridResult(grid.steps, keys, out)
+        if func == "clamp_min":
+            return GridResult(grid.steps, keys,
+                              np.maximum(grid.values, float(args[0])))
+        if func == "clamp_max":
+            return GridResult(grid.steps, keys,
+                              np.minimum(grid.values, float(args[0])))
+        if func in ("days_in_month", "day_of_month", "day_of_week",
+                    "day_of_year", "hour", "minute", "month", "year"):
+            return _time_component(grid, func, keys)
+    raise QueryError(f"unknown instant function {func}")
+
+
+def _time_component(grid: GridResult, func: str, keys) -> GridResult:
+    import datetime as dt
+    v = grid.values
+    out = np.full_like(v, np.nan)
+    it = np.nditer(v, flags=["multi_index"])
+    for x in it:
+        if np.isnan(x):
+            continue
+        d = dt.datetime.fromtimestamp(float(x), dt.timezone.utc)
+        out[it.multi_index] = {
+            "days_in_month": ((d.replace(month=d.month % 12 + 1, day=1,
+                                         year=d.year + d.month // 12)
+                               - dt.timedelta(days=1)).day),
+            "day_of_month": d.day,
+            "day_of_week": (d.weekday() + 1) % 7,
+            "day_of_year": d.timetuple().tm_yday,
+            "hour": d.hour,
+            "minute": d.minute,
+            "month": d.month,
+            "year": d.year,
+        }[func]
+    return GridResult(grid.steps, keys, out)
+
+
+def histogram_quantile(grid: GridResult, q: float) -> GridResult:
+    """histogram_quantile over native histogram columns — vectorized over
+    [S, T] (InstantFunction.scala HistogramQuantileImpl; bucket math
+    memory/format/vectors/Histogram.scala quantile)."""
+    if not grid.is_hist():
+        raise QueryError("histogram_quantile requires histogram input")
+    hv = grid.hist_values  # [S, T, NB]
+    les = np.asarray(grid.bucket_les, dtype=np.float64)
+    S, T, NB = hv.shape
+    out = np.full((S, T), np.nan)
+    for s in range(S):
+        for t in range(T):
+            col = hv[s, t]
+            if np.isnan(col[-1]):
+                continue
+            out[s, t] = bh.quantile(q, les, col)
+    keys = [strip_metric(k) for k in grid.keys]
+    return GridResult(grid.steps, keys, out)
+
+
+def histogram_bucket(grid: GridResult, le: float) -> GridResult:
+    if not grid.is_hist():
+        raise QueryError("histogram_bucket requires histogram input")
+    les = np.asarray(grid.bucket_les, dtype=np.float64)
+    idx = np.where(les == le)[0]
+    keys = [strip_metric(k) for k in grid.keys]
+    if idx.size == 0:
+        return GridResult(grid.steps, keys,
+                          np.full(grid.hist_values.shape[:2], np.nan))
+    return GridResult(grid.steps, keys, grid.hist_values[:, :, idx[0]])
+
+
+# ---------------------------------------------------------------------------
+# Miscellaneous functions (MiscellaneousFunction.scala)
+# ---------------------------------------------------------------------------
+
+def label_replace(grid: GridResult, dst: str, repl: str, src: str,
+                  regex: str) -> GridResult:
+    try:
+        pat = re.compile(regex)
+    except re.error as e:
+        raise QueryError(f"invalid regex: {e}")
+    keys = []
+    for k in grid.keys:
+        k = dict(k)
+        val = k.get(src, "")
+        m = pat.fullmatch(val)
+        if m:
+            new = m.expand(_promql_template(repl))
+            if new:
+                k[dst] = new
+            else:
+                k.pop(dst, None)
+        keys.append(k)
+    return GridResult(grid.steps, keys, grid.values, grid.hist_values,
+                      grid.bucket_les)
+
+
+def _promql_template(repl: str) -> str:
+    # PromQL uses $1; python re.expand uses \1
+    return re.sub(r"\$(\d+)", r"\\\1", repl)
+
+
+def label_join(grid: GridResult, dst: str, sep: str,
+               srcs: Sequence[str]) -> GridResult:
+    keys = []
+    for k in grid.keys:
+        k = dict(k)
+        k[dst] = sep.join(k.get(s, "") for s in srcs)
+        keys.append(k)
+    return GridResult(grid.steps, keys, grid.values, grid.hist_values,
+                      grid.bucket_les)
+
+
+def sort_grid(grid: GridResult, descending: bool) -> GridResult:
+    """sort()/sort_desc(): order series by value of last step
+    (SortFunctionMapper :297)."""
+    if grid.num_series == 0:
+        return grid
+    lastv = grid.values[:, -1]
+    score = np.where(np.isnan(lastv), -np.inf if not descending else np.inf,
+                     lastv)
+    order = np.argsort(-score if descending else score, kind="stable")
+    return GridResult(grid.steps, [grid.keys[i] for i in order],
+                      grid.values[order])
+
+
+def limit_grid(grid: GridResult, limit: int) -> GridResult:
+    if limit <= 0 or grid.num_series <= limit:
+        return grid
+    return GridResult(grid.steps, grid.keys[:limit], grid.values[:limit],
+                      None if grid.hist_values is None
+                      else grid.hist_values[:limit], grid.bucket_les)
+
+
+def absent_fn(grid: GridResult, filters: Sequence[ColumnFilter],
+              steps: np.ndarray) -> GridResult:
+    """absent(): 1 where no series has a value (AbsentFunctionMapper :420).
+    Output labels come from equality filters (Prometheus semantics)."""
+    if grid.num_series == 0:
+        present = np.zeros(steps.size, dtype=bool)
+    else:
+        present = (~np.isnan(grid.values)).any(axis=0)
+    out = np.where(present, np.nan, 1.0)
+    labels = {f.label: f.value for f in filters
+              if f.op == "eq" and f.label not in METRIC_LABELS}
+    if present.all():
+        return GridResult(steps, [], np.zeros((0, steps.size)))
+    return GridResult(steps, [labels], out[None, :])
+
+
+# ---------------------------------------------------------------------------
+# Scalar plans
+# ---------------------------------------------------------------------------
+
+def eval_scalar(plan, engine) -> ScalarResult:
+    if isinstance(plan, lp.ScalarFixedDoublePlan):
+        steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+        return ScalarResult(steps, np.full(steps.size, plan.value))
+    if isinstance(plan, lp.ScalarTimeBasedPlan):
+        steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+        if plan.function == "time":
+            return ScalarResult(steps, steps / 1000.0)
+        raise QueryError(f"unknown scalar time function {plan.function}")
+    if isinstance(plan, lp.ScalarVaryingDoublePlan):
+        grid = engine.execute(plan.inner)
+        # scalar(v): value when exactly one series, else NaN — per step
+        if grid.num_series == 1:
+            vals = grid.values[0]
+        elif grid.num_series == 0:
+            vals = np.full(grid.steps.size, np.nan)
+        else:
+            cnt = (~np.isnan(grid.values)).sum(axis=0)
+            vals = np.where(cnt == 1, np.nansum(grid.values, axis=0), np.nan)
+        return ScalarResult(grid.steps, vals)
+    if isinstance(plan, lp.ScalarBinaryOperation):
+        def side(x):
+            if isinstance(x, (int, float)):
+                return float(x)
+            return eval_scalar(x, engine).values
+        a, b = side(plan.lhs), side(plan.rhs)
+        out = _apply_op(plan.op, a, b, return_bool=True) \
+            if plan.op in _COMP else _apply_op(plan.op, a, b, False)
+        steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+        if np.isscalar(out) or out.ndim == 0:
+            out = np.full(steps.size, float(out))
+        return ScalarResult(steps, out)
+    raise QueryError(f"not a scalar plan: {plan}")
+
+
+# ---------------------------------------------------------------------------
+# The engine: logical plan walker
+# ---------------------------------------------------------------------------
+
+class QueryEngine:
+    """Evaluates LogicalPlans against shards (single-process oracle).
+
+    The distributed path (filodb_tpu.parallel) re-uses these primitives with
+    per-shard leaf evaluation + mesh reductions."""
+
+    def __init__(self, shards: Sequence[TimeSeriesShard],
+                 backend: Optional[object] = None):
+        self.shards = list(shards)
+        self.stats = QueryStats()
+        self.backend = backend  # TPU backend hook (query/tpu.py)
+
+    # -- public ----------------------------------------------------------
+    def execute(self, plan):
+        if lp.is_scalar_plan(plan):
+            return eval_scalar(plan, self)
+        if isinstance(plan, lp.LabelValues):
+            vals: set = set()
+            for s in self.shards:
+                vals.update(s.index.label_values(
+                    plan.label, plan.filters, plan.start_ms, plan.end_ms))
+            return sorted(vals)
+        if isinstance(plan, lp.LabelNames):
+            names: set = set()
+            for s in self.shards:
+                names.update(s.index.label_names(
+                    plan.filters, plan.start_ms, plan.end_ms))
+            return sorted(names)
+        if isinstance(plan, lp.SeriesKeysByFilters):
+            out = []
+            for s in self.shards:
+                for pid in s.index.part_ids_from_filters(
+                        plan.filters, plan.start_ms, plan.end_ms):
+                    out.append(dict(s.index.labels_for(pid)))
+            return out
+        return self._eval(plan)
+
+    # -- vector evaluation ------------------------------------------------
+    def _eval(self, plan) -> GridResult:
+        if isinstance(plan, lp.PeriodicSeries):
+            return self._periodic(plan.raw, plan.start_ms, plan.step_ms,
+                                  plan.end_ms, None, plan.lookback_ms, (),
+                                  plan.offset_ms)
+        if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+            return self._periodic(plan.raw, plan.start_ms, plan.step_ms,
+                                  plan.end_ms, plan.function, plan.window_ms,
+                                  plan.func_args, plan.offset_ms)
+        if isinstance(plan, lp.SubqueryWithWindowing):
+            return self._subquery(plan)
+        if isinstance(plan, lp.TopLevelSubquery):
+            return self._eval(plan.inner)
+        if isinstance(plan, lp.Aggregate):
+            inner = self._eval(plan.inner)
+            return aggregate(inner, plan.op, plan.params, tuple(plan.by),
+                             tuple(plan.without))
+        if isinstance(plan, lp.BinaryJoin):
+            lhs = self._eval(plan.lhs)
+            rhs = self._eval(plan.rhs)
+            return binary_join(lhs, rhs, plan.op, plan.cardinality, plan.on,
+                               plan.ignoring, plan.include, plan.return_bool)
+        if isinstance(plan, lp.ScalarVectorBinaryOperation):
+            grid = self._eval(plan.vector)
+            scalar = eval_scalar(plan.scalar, self)
+            return scalar_vector_op(grid, scalar, plan.op, plan.scalar_is_lhs,
+                                    plan.return_bool)
+        if isinstance(plan, lp.ApplyInstantFunction):
+            grid = self._eval(plan.inner)
+            args = [eval_scalar(a, self).values[0] if not isinstance(
+                a, (int, float)) else a for a in plan.func_args]
+            return instant_function(grid, plan.function, args)
+        if isinstance(plan, lp.ApplyMiscellaneousFunction):
+            grid = self._eval(plan.inner)
+            if plan.function == "label_replace":
+                return label_replace(grid, *plan.str_args)
+            if plan.function == "label_join":
+                dst, sep, *srcs = plan.str_args
+                return label_join(grid, dst, sep, srcs)
+            raise QueryError(f"unknown misc function {plan.function}")
+        if isinstance(plan, lp.ApplySortFunction):
+            return sort_grid(self._eval(plan.inner), plan.descending)
+        if isinstance(plan, lp.ApplyLimitFunction):
+            return limit_grid(self._eval(plan.inner), plan.limit)
+        if isinstance(plan, lp.ApplyAbsentFunction):
+            grid = self._eval(plan.inner)
+            steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+            return absent_fn(grid, plan.filters, steps)
+        if isinstance(plan, lp.VectorPlan):
+            sc = eval_scalar(plan.scalar, self)
+            return GridResult(sc.steps, [{}], sc.values[None, :])
+        if isinstance(plan, lp.RawSeriesPlan):
+            # raw export (query endpoint with [range] at top level)
+            series = select_raw_series(self.shards, plan.filters,
+                                       plan.start_ms, plan.end_ms,
+                                       plan.column, self.stats)
+            return series
+        raise QueryError(f"cannot execute plan {type(plan).__name__}")
+
+    def _periodic(self, raw: lp.RawSeriesPlan, start_ms, step_ms, end_ms,
+                  function, window_ms, func_args, offset_ms) -> GridResult:
+        fetch_start = start_ms - window_ms - offset_ms
+        fetch_end = end_ms - offset_ms if offset_ms else end_ms
+        series = select_raw_series(
+            self.shards, raw.filters, fetch_start, fetch_end, raw.column,
+            self.stats)
+        params = RangeParams(start_ms, step_ms, end_ms)
+        if self.backend is not None and function is not None:
+            out = self.backend.periodic_samples(
+                series, params, function, window_ms, func_args, offset_ms)
+            if out is not None:
+                return out
+        return periodic_samples(series, params, function, window_ms,
+                                func_args, offset_ms)
+
+    def _subquery(self, plan: lp.SubqueryWithWindowing) -> GridResult:
+        """func(expr[w:s]): evaluate inner on the subquery grid, then window
+        over the inner steps (SubqueryWithWindowing semantics)."""
+        inner_start = plan.start_ms - plan.window_ms
+        sub = lp_replace_range(plan.inner, inner_start, plan.sub_step_ms,
+                               plan.end_ms)
+        inner = self._eval(sub)
+        steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+        wend = steps - plan.offset_ms
+        wstart = wend - plan.window_ms
+        fn = rf.RANGE_FUNCTIONS.get(plan.function)
+        if fn is None:
+            raise QueryError(f"unknown range function {plan.function}")
+        s1 = plan.func_args[0] if len(plan.func_args) > 0 else None
+        s2 = plan.func_args[1] if len(plan.func_args) > 1 else None
+        rows = []
+        for i in range(inner.num_series):
+            m = ~np.isnan(inner.values[i])
+            rows.append(fn(inner.steps[m], inner.values[i][m], wstart, wend,
+                           scalar=s1, scalar2=s2))
+        values = np.vstack(rows) if rows else np.zeros((0, steps.size))
+        return GridResult(steps, [dict(k) for k in inner.keys], values)
+
+
+def lp_replace_range(plan, start_ms: int, step_ms: int, end_ms: int):
+    """Rewrite a plan's evaluation range (used for subqueries)."""
+    import dataclasses
+    if isinstance(plan, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing)):
+        raw = dataclasses.replace(plan.raw,
+                                  start_ms=start_ms - _plan_window(plan),
+                                  end_ms=end_ms)
+        return dataclasses.replace(plan, raw=raw, start_ms=start_ms,
+                                   step_ms=step_ms, end_ms=end_ms)
+    if isinstance(plan, (lp.Aggregate, lp.ApplyInstantFunction,
+                         lp.ApplyMiscellaneousFunction, lp.ApplySortFunction,
+                         lp.ApplyLimitFunction)):
+        import dataclasses
+        return dataclasses.replace(
+            plan, inner=lp_replace_range(plan.inner, start_ms, step_ms,
+                                         end_ms))
+    if isinstance(plan, lp.BinaryJoin):
+        import dataclasses
+        return dataclasses.replace(
+            plan,
+            lhs=lp_replace_range(plan.lhs, start_ms, step_ms, end_ms),
+            rhs=lp_replace_range(plan.rhs, start_ms, step_ms, end_ms))
+    if isinstance(plan, lp.ScalarVectorBinaryOperation):
+        import dataclasses
+        return dataclasses.replace(
+            plan, vector=lp_replace_range(plan.vector, start_ms, step_ms,
+                                          end_ms))
+    return plan
+
+
+def _plan_window(plan) -> int:
+    if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        return plan.window_ms
+    if isinstance(plan, lp.PeriodicSeries):
+        return plan.lookback_ms
+    return 0

@@ -1,0 +1,102 @@
+"""Query result model: range vectors as dense grid batches.
+
+Replaces the reference's RangeVector / SerializedRangeVector
+(core/src/main/scala/filodb.core/query/RangeVector.scala:124,452) with a
+columnar, device-friendly representation: after windowing, every series in a
+result shares one step grid, so a whole result is ``[num_series, num_steps]``
+matrices + per-series label keys.  No per-row serialization is ever needed
+intra-process (the reference's Kryo path exists only because of the JVM actor
+boundary)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RangeParams:
+    """start/step/end in **milliseconds** (query/TimeStepParams at the edge is
+    seconds; converted at the HTTP layer)."""
+    start_ms: int
+    step_ms: int
+    end_ms: int
+
+    @property
+    def steps(self) -> np.ndarray:
+        if self.step_ms <= 0:
+            return np.array([self.start_ms], dtype=np.int64)
+        return np.arange(self.start_ms, self.end_ms + 1, self.step_ms,
+                         dtype=np.int64)
+
+    @property
+    def num_steps(self) -> int:
+        if self.step_ms <= 0:
+            return 1
+        return (self.end_ms - self.start_ms) // self.step_ms + 1
+
+
+@dataclass
+class RawSeries:
+    """One series' raw samples (RawDataRangeVector equivalent)."""
+    labels: Mapping[str, str]
+    ts: np.ndarray          # int64 ms, sorted
+    values: np.ndarray      # f64 [n] or f64 [n, num_buckets] for histograms
+    is_counter: bool = False
+    bucket_les: Optional[np.ndarray] = None  # for histogram series
+
+
+@dataclass
+class GridResult:
+    """A periodic (windowed) result: shared step grid + per-series rows.
+
+    ``values`` is [num_series, num_steps] float64 (NaN = no sample — carries
+    the reference's NaN/staleness semantics through the pipeline).
+    For histogram results, ``hist_values`` is [num_series, num_steps, nb]."""
+    steps: np.ndarray                       # int64 [num_steps] ms
+    keys: List[Dict[str, str]]              # per-series labels
+    values: np.ndarray                      # f64 [S, T]
+    hist_values: Optional[np.ndarray] = None  # f64 [S, T, NB]
+    bucket_les: Optional[np.ndarray] = None
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+    def is_hist(self) -> bool:
+        return self.hist_values is not None
+
+    @staticmethod
+    def empty(steps: np.ndarray) -> "GridResult":
+        return GridResult(steps, [], np.zeros((0, steps.size)))
+
+
+@dataclass
+class ScalarResult:
+    """scalar(...) / literal results: one value per step."""
+    steps: np.ndarray
+    values: np.ndarray  # f64 [T]
+
+
+@dataclass
+class QueryStats:
+    """(core/query/QueryStats equivalent) threaded through execution."""
+    series_scanned: int = 0
+    samples_scanned: int = 0
+    result_bytes: int = 0
+
+    def add(self, other: "QueryStats") -> None:
+        self.series_scanned += other.series_scanned
+        self.samples_scanned += other.samples_scanned
+        self.result_bytes += other.result_bytes
+
+
+class QueryError(Exception):
+    pass
+
+
+@dataclass
+class QueryWarnings:
+    messages: List[str] = field(default_factory=list)

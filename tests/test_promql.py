@@ -1,0 +1,174 @@
+"""PromQL parser tests (parity model: prometheus/src/test ParserSpec golden
+LogicalPlans)."""
+
+import pytest
+
+from filodb_tpu.core.index import ColumnFilter as CF
+from filodb_tpu.promql.parser import (ParseError, TimeStepParams,
+                                      parse_duration_ms, parse_query_range)
+from filodb_tpu.query import logical as lp
+
+P = TimeStepParams(1000, 10, 2000)
+
+
+def parse(q):
+    return parse_query_range(q, P)
+
+
+def test_durations():
+    assert parse_duration_ms("5m") == 300_000
+    assert parse_duration_ms("1h30m") == 5_400_000
+    assert parse_duration_ms("90s") == 90_000
+    assert parse_duration_ms("1d") == 86_400_000
+    assert parse_duration_ms("100ms") == 100
+
+
+def test_simple_selector():
+    plan = parse('http_requests_total{job="api", instance!="i1"}')
+    assert isinstance(plan, lp.PeriodicSeries)
+    fs = plan.raw.filters
+    assert CF.eq("_metric_", "http_requests_total") in fs
+    assert CF.eq("job", "api") in fs
+    assert CF.neq("instance", "i1") in fs
+    assert plan.start_ms == 1_000_000 and plan.end_ms == 2_000_000
+    assert plan.step_ms == 10_000
+
+
+def test_name_matcher_and_regex():
+    plan = parse('{__name__="foo", job=~"a.*"}')
+    fs = plan.raw.filters
+    assert CF.eq("_metric_", "foo") in fs
+    assert CF.regex("job", "a.*") in fs
+
+
+def test_rate_window():
+    plan = parse("rate(http_requests_total[5m])")
+    assert isinstance(plan, lp.PeriodicSeriesWithWindowing)
+    assert plan.function == "rate"
+    assert plan.window_ms == 300_000
+    # raw fetch range extends back by the window
+    assert plan.raw.start_ms == 1_000_000 - 300_000
+
+
+def test_aggregate_by():
+    plan = parse("sum by (job) (rate(http_requests_total[5m]))")
+    assert isinstance(plan, lp.Aggregate)
+    assert plan.op == "sum" and plan.by == ("job",)
+    plan2 = parse("sum(rate(http_requests_total[5m])) by (job)")
+    assert plan2.by == ("job",)
+    plan3 = parse("sum without (instance) (foo)")
+    assert plan3.without == ("instance",)
+
+
+def test_topk_quantile_count_values():
+    plan = parse("topk(5, foo)")
+    assert plan.op == "topk" and plan.params == (5.0,)
+    plan = parse("quantile(0.9, foo)")
+    assert plan.op == "quantile" and plan.params == (0.9,)
+    plan = parse('count_values("version", build_info)')
+    assert plan.op == "count_values" and plan.params == ("version",)
+
+
+def test_binary_join_precedence():
+    plan = parse("a + b * c")
+    assert isinstance(plan, lp.BinaryJoin)
+    assert plan.op == "+"
+    assert isinstance(plan.rhs, lp.BinaryJoin)
+    assert plan.rhs.op == "*"
+
+
+def test_scalar_vector_op():
+    plan = parse("foo > 10")
+    assert isinstance(plan, lp.ScalarVectorBinaryOperation)
+    assert not plan.scalar_is_lhs
+    plan = parse("10 < foo")
+    assert plan.scalar_is_lhs
+    plan = parse("foo > bool 10")
+    assert plan.return_bool
+
+
+def test_on_group_left():
+    plan = parse("a * on (job) group_left (version) b")
+    assert isinstance(plan, lp.BinaryJoin)
+    assert plan.on == ("job",)
+    assert plan.cardinality == "many-to-one"
+    assert plan.include == ("version",)
+
+
+def test_set_ops():
+    plan = parse("a and b or c unless d")
+    assert isinstance(plan, lp.BinaryJoin)
+    assert plan.op == "or"
+
+
+def test_offset():
+    plan = parse("rate(foo[5m] offset 10m)")
+    assert plan.offset_ms == 600_000
+    plan = parse("foo offset 1h")
+    assert plan.offset_ms == 3_600_000
+
+
+def test_instant_functions():
+    plan = parse("abs(foo)")
+    assert isinstance(plan, lp.ApplyInstantFunction)
+    plan = parse("clamp(foo, 0, 10)")
+    assert plan.func_args == (0.0, 10.0)
+    plan = parse("histogram_quantile(0.99, sum(rate(req_bucket[5m])) by (le))")
+    assert plan.function == "histogram_quantile"
+    assert plan.func_args == (0.99,)
+
+
+def test_quantile_over_time_scalar_first():
+    plan = parse("quantile_over_time(0.95, latency[10m])")
+    assert isinstance(plan, lp.PeriodicSeriesWithWindowing)
+    assert plan.function == "quantile_over_time"
+    assert plan.func_args == (0.95,)
+
+
+def test_predict_linear_and_holt_winters():
+    plan = parse("predict_linear(foo[1h], 3600)")
+    assert plan.func_args == (3600.0,)
+    plan = parse("holt_winters(foo[1h], 0.5, 0.1)")
+    assert plan.func_args == (0.5, 0.1)
+
+
+def test_subquery():
+    plan = parse("max_over_time(rate(foo[5m])[30m:1m])")
+    assert isinstance(plan, lp.SubqueryWithWindowing)
+    assert plan.function == "max_over_time"
+    assert plan.window_ms == 1_800_000
+    assert plan.sub_step_ms == 60_000
+    assert isinstance(plan.inner, lp.PeriodicSeriesWithWindowing)
+
+
+def test_scalar_exprs():
+    plan = parse("1 + 2 * 3")
+    assert isinstance(plan, lp.ScalarBinaryOperation)
+    plan = parse("scalar(foo) + 1")
+    assert isinstance(plan, lp.ScalarBinaryOperation)
+    plan = parse("vector(1)")
+    assert isinstance(plan, lp.VectorPlan)
+
+
+def test_label_replace():
+    plan = parse('label_replace(foo, "dst", "$1", "src", "(.*)")')
+    assert isinstance(plan, lp.ApplyMiscellaneousFunction)
+    assert plan.str_args == ("dst", "$1", "src", "(.*)")
+
+
+def test_sort_absent():
+    assert isinstance(parse("sort_desc(foo)"), lp.ApplySortFunction)
+    plan = parse('absent(foo{job="x"})')
+    assert isinstance(plan, lp.ApplyAbsentFunction)
+    assert CF.eq("job", "x") in plan.filters
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("rate(foo)")          # missing window
+    with pytest.raises(ParseError):
+        parse("foo[5m]")            # bare range vector
+    with pytest.raises(ParseError):
+        parse("sum(")               # truncated
+    with pytest.raises(ParseError):
+        parse("foo{job=bar}")       # unquoted matcher value
