@@ -1,0 +1,340 @@
+"""TPU/JAX backend for the windowed query hot loop.
+
+This replaces the reference's per-row iterator hot loop
+(query/exec/PeriodicSamplesMapper.scala:223 ChunkedWindowIterator;
+rangefn/RangeFunction.scala:122 addChunks binary-search + accumulate) with a
+single fused XLA computation over dense series tiles:
+
+  1. Series are packed host-side into padded ``[S, N]`` tiles (timestamps
+     int64, values float64; NaN stale markers dropped during packing).
+  2. Per-window index ranges come from a vmapped ``searchsorted`` — the
+     device-wide analogue of the reference's per-chunk binary search.
+  3. Endpoint functions (rate family, last/first) and prefix-sum functions
+     (sum/avg/count/stddev/changes/resets) are computed from cumulative sums
+     — O(samples + windows), no per-window gather.
+  4. Order-statistic functions (min/max/quantile) gather a bounded window
+     tile ``[S, T, W]`` and reduce over the W axis.
+
+Counter correction (reset detection) is a device-side cumsum of drops —
+the vectorized equivalent of CorrectingDoubleVectorReader
+(memory/format/vectors/DoubleVector.scala:301) with cross-chunk carryover
+folded in for free (tiles are whole series, not chunks).
+
+Shapes are bucketized (pow2 padding of S and N) so XLA compiles a small
+number of kernels that get reused across queries.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+# Prometheus semantics require f64 values and i64 millisecond timestamps;
+# XLA supports both on TPU (f64 via emulation on the scalar/vector units).
+# Must be enabled before any kernel is traced.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
+
+# sentinel timestamp for padding: larger than any real ms timestamp
+_TS_PAD = np.int64(1) << 60
+
+# functions implemented on device; everything else falls back to the oracle
+DEVICE_FUNCS = frozenset({
+    "rate", "increase", "delta", "irate", "idelta",
+    "sum_over_time", "count_over_time", "avg_over_time",
+    "stddev_over_time", "stdvar_over_time", "z_score",
+    "min_over_time", "max_over_time", "last_sample", "last_over_time",
+    "first_over_time", "changes", "resets", "timestamp",
+    "rate_over_delta", "increase_over_delta", "quantile_over_time",
+    "present_over_time", "absent_over_time",
+})
+
+_ENDPOINT_RATE = {"rate": (True, True), "increase": (True, False),
+                  "delta": (False, False)}
+
+
+def _next_pow2(n: int, lo: int = 8) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack ragged raw series into padded [S, N] tiles (host side).
+
+    By default drops NaN samples (stale markers) so device code needn't mask
+    them — matches the oracle's _prep.  The instant-selector path
+    (last_sample) keeps NaNs: a stale marker must make the step stale.
+    Returns (ts_pad i64, vals f64, lens i32)."""
+    cleaned: List[Tuple[np.ndarray, np.ndarray]] = []
+    maxlen = 1
+    for s in series:
+        if drop_nan:
+            m = ~np.isnan(s.values)
+            ts, vals = s.ts[m], s.values[m]
+        else:
+            ts, vals = s.ts, s.values
+        cleaned.append((ts, vals))
+        maxlen = max(maxlen, ts.size)
+    N = _next_pow2(maxlen)
+    S = len(series)
+    ts_pad = np.full((S, N), _TS_PAD, dtype=np.int64)
+    vals_pad = np.zeros((S, N), dtype=np.float64)
+    lens = np.zeros(S, dtype=np.int32)
+    for i, (ts, vals) in enumerate(cleaned):
+        n = ts.size
+        ts_pad[i, :n] = ts
+        vals_pad[i, :n] = vals
+        lens[i] = n
+    return ts_pad, vals_pad, lens
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+def _bounds(ts, wstart, wend):
+    """[S, T] window index bounds via vmapped searchsorted."""
+    lo = jax.vmap(lambda row: jnp.searchsorted(row, wstart, side="left"))(ts)
+    hi = jax.vmap(lambda row: jnp.searchsorted(row, wend, side="right"))(ts) - 1
+    return lo, hi
+
+
+def _take(arr, idx):
+    return jnp.take_along_axis(arr, idx, axis=1)
+
+
+def _prefix(x):
+    """[S, N] -> [S, N+1] exclusive prefix sums."""
+    return jnp.concatenate(
+        [jnp.zeros((x.shape[0], 1), x.dtype), jnp.cumsum(x, axis=1)], axis=1)
+
+
+def _correction(vals, lens):
+    """Counter-reset correction per sample: cumsum of drop magnitudes."""
+    idx = jnp.arange(vals.shape[1])
+    valid = idx[None, :] < lens[:, None]
+    prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+    dropped = (vals < prev) & valid & (idx[None, :] > 0)
+    drops = jnp.where(dropped, prev, 0.0)
+    return jnp.cumsum(drops, axis=1)
+
+
+def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
+                       is_rate):
+    """(rangefn/RateFunctions.scala:37 extrapolatedRate, on device.)"""
+    counts = counts.astype(jnp.float64)
+    dstart = (t1 - wstart[None, :]).astype(jnp.float64) / 1000.0
+    dend = (wend[None, :] - t2).astype(jnp.float64) / 1000.0
+    sampled = (t2 - t1).astype(jnp.float64) / 1000.0
+    avg_dur = sampled / (counts - 1.0)
+    delta = v2 - v1
+    if is_counter:
+        dzero = jnp.where((delta > 0) & (v1 >= 0),
+                          sampled * (v1 / jnp.where(delta == 0, jnp.nan,
+                                                    delta)),
+                          jnp.inf)
+        dstart = jnp.minimum(dstart, dzero)
+    thresh = avg_dur * 1.1
+    extrap = sampled \
+        + jnp.where(dstart < thresh, dstart, avg_dur / 2.0) \
+        + jnp.where(dend < thresh, dend, avg_dur / 2.0)
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        scaled = scaled / (wend - wstart)[None, :] * 1000.0
+    return jnp.where(counts >= 2, scaled, jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "is_counter"))
+def _window_endpoint(func: str, is_counter: bool, ts, vals, lens, wstart,
+                     wend, scalar):
+    """Endpoint + prefix-sum family, one fused kernel."""
+    S, N = ts.shape
+    lo, hi = _bounds(ts, wstart, wend)
+    counts = hi - lo + 1
+    has = counts >= 1
+    lo_c = jnp.clip(lo, 0, N - 1)
+    hi_c = jnp.clip(hi, 0, N - 1)
+    nan = jnp.nan
+
+    if func in _ENDPOINT_RATE:
+        counter, is_rate = _ENDPOINT_RATE[func]
+        v = vals + _correction(vals, lens) if counter else vals
+        out = _extrapolated_rate(wstart, wend, counts,
+                                 _take(ts, lo_c), _take(v, lo_c),
+                                 _take(ts, hi_c), _take(v, hi_c),
+                                 counter, is_rate)
+        return jnp.where(has, out, nan)
+
+    if func in ("irate", "idelta"):
+        ok = counts >= 2
+        hi2 = jnp.clip(hi, 1, N - 1)
+        v2 = _take(vals, hi2)
+        v1 = _take(vals, hi2 - 1)
+        dv = v2 - v1
+        if func == "irate":
+            dv = jnp.where(dv < 0, v2, dv)
+            dt = (_take(ts, hi2) - _take(ts, hi2 - 1)).astype(jnp.float64) \
+                / 1000.0
+            res = dv / jnp.where(dt == 0, jnp.nan, dt)
+        else:
+            res = dv
+        return jnp.where(ok, res, nan)
+
+    if func in ("last_sample", "last_over_time"):
+        return jnp.where(has, _take(vals, hi_c), nan)
+    if func == "first_over_time":
+        return jnp.where(has, _take(vals, lo_c), nan)
+    if func == "timestamp":
+        return jnp.where(has, _take(ts, hi_c).astype(jnp.float64) / 1000.0,
+                         nan)
+    if func == "present_over_time":
+        return jnp.where(has, 1.0, nan)
+    if func == "absent_over_time":
+        return jnp.where(has, nan, 1.0)
+
+    if func in ("changes", "resets"):
+        prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+        idx = jnp.arange(N)
+        valid = (idx[None, :] < lens[:, None]) & (idx[None, :] > 0)
+        if func == "changes":
+            ev = (vals != prev) & valid
+        else:
+            ev = (vals < prev) & valid
+        cs = _prefix(ev.astype(jnp.float64))
+        lo1 = jnp.clip(lo + 1, 0, N)
+        out = _take(cs, jnp.clip(hi + 1, 0, N)) - _take(cs, lo1)
+        return jnp.where(has, out, nan)
+
+    # prefix-sum family
+    cs = _prefix(vals)
+    s = _take(cs, jnp.clip(hi + 1, 0, N)) - _take(cs, jnp.clip(lo, 0, N))
+    cnt = counts.astype(jnp.float64)
+    if func in ("sum_over_time", "increase_over_delta"):
+        out = s
+    elif func == "rate_over_delta":
+        out = s / (wend - wstart)[None, :] * 1000.0
+    elif func == "count_over_time":
+        out = cnt
+    elif func == "avg_over_time":
+        out = s / cnt
+    else:
+        cs2 = _prefix(vals * vals)
+        s2 = _take(cs2, jnp.clip(hi + 1, 0, N)) - _take(cs2,
+                                                        jnp.clip(lo, 0, N))
+        mean = s / cnt
+        var = jnp.maximum(s2 / cnt - mean * mean, 0.0)
+        if func == "stdvar_over_time":
+            out = var
+        elif func == "stddev_over_time":
+            out = jnp.sqrt(var)
+        elif func == "z_score":
+            out = (_take(vals, hi_c) - mean) / jnp.sqrt(var)
+        else:
+            raise ValueError(f"unhandled device func {func}")
+    return jnp.where(has, out, nan)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "w_bound"))
+def _window_gather(func: str, w_bound: int, ts, vals, lens, wstart, wend,
+                   scalar):
+    """Order-statistic family: gather [S, T, W] window tiles, reduce over W.
+    W (max samples per window) is a static bound."""
+    S, N = ts.shape
+    lo, hi = _bounds(ts, wstart, wend)          # [S, T]
+    has = hi >= lo
+    offs = jnp.arange(w_bound)                  # [W]
+    gidx = lo[:, :, None] + offs[None, None, :]  # [S, T, W]
+    in_win = (gidx <= hi[:, :, None]) & (gidx < lens[:, None, None])
+    gidx_c = jnp.clip(gidx, 0, N - 1)
+    g = jnp.take_along_axis(vals, gidx_c.reshape(S, -1), axis=1).reshape(
+        gidx.shape)
+    if func == "min_over_time":
+        out = jnp.min(jnp.where(in_win, g, jnp.inf), axis=2)
+        out = jnp.where(jnp.isinf(out), jnp.nan, out)
+    elif func == "max_over_time":
+        out = jnp.max(jnp.where(in_win, g, -jnp.inf), axis=2)
+        out = jnp.where(jnp.isinf(out), jnp.nan, out)
+    elif func == "quantile_over_time":
+        q = jnp.clip(scalar, 0.0, 1.0)
+        big = jnp.where(in_win, g, jnp.inf)
+        srt = jnp.sort(big, axis=2)              # valid values first
+        cnt = in_win.sum(axis=2)                 # [S, T]
+        rank = q * (cnt - 1).astype(jnp.float64)
+        lo_r = jnp.floor(rank).astype(jnp.int32)
+        hi_r = jnp.ceil(rank).astype(jnp.int32)
+        frac = rank - lo_r
+        v_lo = jnp.take_along_axis(srt, jnp.clip(lo_r, 0, w_bound - 1)[..., None],
+                                   axis=2)[..., 0]
+        v_hi = jnp.take_along_axis(srt, jnp.clip(hi_r, 0, w_bound - 1)[..., None],
+                                   axis=2)[..., 0]
+        out = v_lo + (v_hi - v_lo) * frac
+        out = jnp.where(cnt > 0, out, jnp.nan)
+        out = jnp.where(scalar > 1, jnp.inf, out)
+        out = jnp.where(scalar < 0, -jnp.inf, out)
+    else:
+        raise ValueError(f"unhandled gather func {func}")
+    return jnp.where(has, out, jnp.nan)
+
+
+_GATHER_FUNCS = frozenset({"min_over_time", "max_over_time",
+                           "quantile_over_time"})
+
+
+class TpuBackend:
+    """Pluggable device backend for QueryEngine (the ``--exec-backend=tpu``
+    boundary from BASELINE.json)."""
+
+    def __init__(self, device: Optional[object] = None):
+        self.device = device
+
+    def periodic_samples(self, series: Sequence[RawSeries],
+                         params: RangeParams, function: str, window_ms: int,
+                         func_args: Sequence[float] = (),
+                         offset_ms: int = 0) -> Optional[GridResult]:
+        """Returns None to signal fallback to the numpy oracle (histograms,
+        unsupported functions)."""
+        func = function or "last_sample"
+        if func not in DEVICE_FUNCS or not series:
+            return None
+        if any(s.values.ndim != 1 for s in series):
+            return None
+        steps = params.steps
+        wend = steps - offset_ms
+        wstart = wend - window_ms
+        ts, vals, lens = pack_series(series, drop_nan=(func != "last_sample"))
+        scalar = float(func_args[0]) if func_args else 0.0
+        if func in _GATHER_FUNCS:
+            w_bound = self._window_sample_bound(series, window_ms, ts.shape[1])
+            out = _window_gather(func, w_bound, ts, vals, lens,
+                                 jnp.asarray(wstart), jnp.asarray(wend),
+                                 scalar)
+        else:
+            out = _window_endpoint(func, False, ts, vals, lens,
+                                   jnp.asarray(wstart), jnp.asarray(wend),
+                                   scalar)
+        keys = [dict(s.labels) for s in series]
+        return GridResult(steps, keys, np.asarray(out))
+
+    @staticmethod
+    def _window_sample_bound(series, window_ms: int, n_cap: int) -> int:
+        """Static upper bound on samples per window: window / min-interval."""
+        min_dt = None
+        for s in series:
+            if s.ts.size >= 2:
+                d = np.diff(s.ts).min()
+                if d > 0:
+                    min_dt = d if min_dt is None else min(min_dt, d)
+        if min_dt is None or min_dt <= 0:
+            return n_cap
+        bound = int(window_ms // int(min_dt)) + 2
+        return min(_next_pow2(bound, 4), max(n_cap, 4))

@@ -1,15 +1,22 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding paths can be exercised without TPU hardware (mirrors the reference's
 sbt-multi-jvm strategy of multi-node tests without a real cluster —
-reference: project/FiloBuild.scala:100)."""
+reference: project/FiloBuild.scala:100).
+
+Note: this environment pre-imports jax (sitecustomize) pointed at real TPU
+hardware, so plain env vars are too late — use jax.config.update, which works
+as long as no backend has been initialized yet.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
