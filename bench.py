@@ -1,0 +1,84 @@
+"""Benchmark: PromQL `sum(rate(counter[5m])) by (job)` samples-scanned/sec
+on device (the BASELINE.json north-star workload, promperf shape —
+reference harness: jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N}
+vs_baseline = device throughput / numpy-oracle (CPU reference path)
+throughput, since the reference publishes no absolute numbers
+(BASELINE.md: its contract is the harness, not results).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _gen_tiles(S, N, seed=42):
+    """Counter series tiles [S, N] at 10s cadence with jittered phase."""
+    rng = np.random.default_rng(seed)
+    dt = 10_000
+    ts = (np.arange(N, dtype=np.int64) * dt)[None, :] \
+        + rng.integers(0, dt, (S, 1))
+    vals = np.cumsum(rng.uniform(0.0, 5.0, (S, N)), axis=1)
+    lens = np.full(S, N, dtype=np.int32)
+    return ts, vals, lens
+
+
+def main():
+    from filodb_tpu.query.tpu import _window_endpoint
+    from __graft_entry__ import _rate_sum_step
+
+    S, N = 65_536, 512            # 33.5M samples scanned per query
+    n_groups = 16
+    T = 180                       # 3h of 1-minute output steps
+    window_ms = 300_000
+    ts, vals, lens = _gen_tiles(S, N)
+    gids = (np.arange(S) % n_groups).astype(np.int32)
+    step_ms = 60_000
+    wend = np.int64(window_ms) + np.arange(T, dtype=np.int64) * step_ms
+    wstart = wend - window_ms
+
+    dev_args = tuple(jax.device_put(jnp.asarray(a))
+                     for a in (ts, vals, lens, gids)) + (
+        jnp.asarray(wstart[0]), jnp.asarray(wend[0]),
+        jnp.asarray(np.int64(step_ms)))
+    fn = jax.jit(_rate_sum_step(n_groups, T))
+    np.asarray(fn(*dev_args))                  # compile + settle
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*dev_args)
+    np.asarray(out)                            # host sync (tunnel-safe)
+    dt_dev = (time.perf_counter() - t0) / iters
+    device_sps = S * N / dt_dev
+
+    # CPU numpy-oracle on a subsample, extrapolated (reference exec path)
+    from filodb_tpu.query import rangefn as rf
+    S_cpu = 512
+    t0 = time.perf_counter()
+    acc = np.zeros(T)
+    for i in range(S_cpu):
+        row = rf.evaluate("rate", ts[i], vals[i], int(wend[0]), step_ms,
+                          int(wend[-1]), window_ms)
+        acc += np.where(np.isnan(row), 0.0, row)
+    dt_cpu = time.perf_counter() - t0
+    oracle_sps = S_cpu * N / dt_cpu
+
+    print(json.dumps({
+        "metric": "rate_sum_by_samples_scanned_per_sec",
+        "value": round(device_sps),
+        "unit": "samples/s",
+        "vs_baseline": round(device_sps / oracle_sps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

