@@ -1,0 +1,163 @@
+"""Pallas TPU kernels for the windowed query hot loop.
+
+The reference's inner loop (rangefn/RangeFunction.scala:122 addChunks:
+per-chunk binary search + accumulate per window) becomes one fused kernel
+over dense series tiles. XLA-level formulations are all bottlenecked on
+TPU: vmapped searchsorted serializes, per-element gathers cost ~40ns, f64
+scatters ~100ns. This kernel instead computes, per (series row, window):
+
+  * ``started[t,i] = ts_i <= wend_t`` and ``after[t,i] = ts_i >= wstart_t``
+    — with sorted rows these are prefix/suffix masks, so the FIRST sample
+    >= wstart and LAST sample <= wend are mask XOR-shifts (no search);
+  * window sample counts as mask reductions;
+  * boundary timestamps/values as one-hot masked reductions (each has
+    exactly ONE nonzero term, so f32/int32 accumulation is exact).
+
+f64 payloads (Prometheus semantics) are carried as THREE f32 channels
+(24+24+5 mantissa bits >= 53): split3() is exact, each channel extraction
+is exact, and the f64 recombine outside the kernel is exact.
+
+Timestamps enter as int32 offsets relative to the first window start —
+callers must guard that the whole query span fits in int31 (~24.8 days).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# int32 sentinel for padded samples: beyond any valid relative timestamp
+TR_PAD = np.int32(2**31 - 1)
+
+# tile sizes: BS series rows x TT windows per program (TPU block tiling
+# requires multiples of (8, 128) on the trailing dims); the kernel loops
+# over _TC-window chunks internally so mask temporaries stay [BS, TC, N]
+_BS = 8
+_TT = 128
+_TC = 32
+
+
+def split3(v: jnp.ndarray) -> jnp.ndarray:
+    """Exactly split f64 [S, N] into three stacked f32 channels [S, 3, N]:
+    v == h + m + l with no rounding (53 <= 24+24+24 mantissa bits)."""
+    h = v.astype(jnp.float32)
+    r = v - h.astype(jnp.float64)
+    m = r.astype(jnp.float32)
+    l = (r - m.astype(jnp.float64)).astype(jnp.float32)
+    return jnp.stack([h, m, l], axis=1)
+
+
+def combine3(c: jnp.ndarray) -> jnp.ndarray:
+    """[..., 3, T] f32 channels -> f64 (exact)."""
+    return (c[..., 0, :].astype(jnp.float64)
+            + c[..., 1, :].astype(jnp.float64)
+            + c[..., 2, :].astype(jnp.float64))
+
+
+def _extract_kernel(nchan: int, params_ref, tr_ref, pay_ref,
+                    cnt_ref, tlo_ref, thi_ref, plo_ref, phi_ref):
+    """One (series-tile, window-tile) program."""
+    j = pl.program_id(1)
+    step = params_ref[0, 0]
+    window = params_ref[0, 1]
+    tr = tr_ref[:]                                        # [BS, N] i32
+    trb = tr[:, None, :]                                  # [BS, 1, N]
+    # neighbor timestamps (computed once, 2D int32 — Mosaic cannot
+    # concatenate i1 vectors, so shift masks are derived by comparison)
+    tr_next = jnp.concatenate(
+        [tr[:, 1:], jnp.full_like(tr[:, :1], TR_PAD)], axis=1)
+    tr_prev = jnp.concatenate(
+        [jnp.full_like(tr[:, :1], jnp.int32(-2**31)), tr[:, :-1]], axis=1)
+    trn = tr_next[:, None, :]
+    trp = tr_prev[:, None, :]
+    for sub in range(_TT // _TC):
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, (1, _TC, 1), 1)
+        wstart = (j * _TT + sub * _TC + t_idx) * step     # [1, TC, 1]
+        wend = wstart + window
+        started = trb <= wend                             # [BS, TC, N]
+        after = trb >= wstart
+        inwin = started & after
+        sl_t = slice(sub * _TC, (sub + 1) * _TC)
+        cnt_ref[:, sl_t] = jnp.where(inwin, jnp.int32(1),
+                                     jnp.int32(0)).sum(
+            axis=2, dtype=jnp.int32)
+        # last in-window sample: started is prefix-true (rows sorted),
+        # so the transition is where the NEXT sample is past wend
+        oh_hi = started & (trn > wend) & after
+        # first in-window sample: after is suffix-true; transition where
+        # the PREVIOUS sample is before wstart
+        oh_lo = after & (trp < wstart) & started
+        tlo_ref[:, sl_t] = jnp.where(oh_lo, trb, jnp.int32(0)).sum(
+            axis=2, dtype=jnp.int32)
+        thi_ref[:, sl_t] = jnp.where(oh_hi, trb, jnp.int32(0)).sum(
+            axis=2, dtype=jnp.int32)
+        for c in range(nchan):
+            v = pay_ref[:, c, :][:, None, :]              # [BS, 1, N]
+            plo_ref[:, c, sl_t] = jnp.where(oh_lo, v, jnp.float32(0)).sum(
+                axis=2, dtype=jnp.float32)
+            phi_ref[:, c, sl_t] = jnp.where(oh_hi, v, jnp.float32(0)).sum(
+                axis=2, dtype=jnp.float32)
+
+
+def window_extract(tr: jnp.ndarray, pay: jnp.ndarray,
+                   step, window, nsteps: int,
+                   interpret: bool = False
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                              jnp.ndarray, jnp.ndarray]:
+    """Run the boundary-extract kernel.
+
+    tr:  [S, N] int32 sample times relative to the FIRST window start
+         (pad = TR_PAD). S must be a multiple of the row tile.
+    pay: [S, C, N] f32 payload channels to extract at window boundaries.
+    Windows: wstart_t = t*step (relative), wend_t = wstart_t + window.
+
+    Returns (counts i32 [S,T], t_lo i32, t_hi i32,
+             pay_at_lo f32 [S,C,T], pay_at_hi f32 [S,C,T]) — entries only
+    meaningful where counts >= 1."""
+    S, C, N = pay.shape
+    T_pad = -(-nsteps // _TT) * _TT
+    S_pad = -(-S // _BS) * _BS
+    if S_pad != S:
+        tr = jnp.pad(tr, ((0, S_pad - S), (0, 0)),
+                     constant_values=TR_PAD)
+        pay = jnp.pad(pay, ((0, S_pad - S), (0, 0), (0, 0)))
+    params = jnp.array([[step, window]], dtype=jnp.int32)
+    grid = (S_pad // _BS, T_pad // _TT)
+    out_shapes = (
+        jax.ShapeDtypeStruct((S_pad, T_pad), jnp.int32),
+        jax.ShapeDtypeStruct((S_pad, T_pad), jnp.int32),
+        jax.ShapeDtypeStruct((S_pad, T_pad), jnp.int32),
+        jax.ShapeDtypeStruct((S_pad, C, T_pad), jnp.float32),
+        jax.ShapeDtypeStruct((S_pad, C, T_pad), jnp.float32),
+    )
+    st_spec = pl.BlockSpec((_BS, _TT), lambda i, j: (i, j),
+                           memory_space=pltpu.VMEM)
+    st3_spec = pl.BlockSpec((_BS, C, _TT), lambda i, j: (i, 0, j),
+                            memory_space=pltpu.VMEM)
+    # trace the kernel in 32-bit mode: under jax_enable_x64, index-map and
+    # literal constants become i64, which Mosaic cannot legalize
+    with jax.enable_x64(False):
+        outs = pl.pallas_call(
+            functools.partial(_extract_kernel, C),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 2), lambda i, j: (0, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((_BS, N), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((_BS, C, N), lambda i, j: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=(st_spec, st_spec, st_spec, st3_spec, st3_spec),
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(params, tr, pay)
+    cnt, tlo, thi, plo, phi = outs
+    return (cnt[:S, :nsteps], tlo[:S, :nsteps], thi[:S, :nsteps],
+            plo[:S, :, :nsteps], phi[:S, :, :nsteps])

@@ -328,6 +328,60 @@ def _window_gather(func: str, w_bound: int, ts, vals, lens, w0s, w0e,
 _GATHER_FUNCS = frozenset({"min_over_time", "max_over_time",
                            "quantile_over_time"})
 
+# rate family served by the Pallas boundary-extract kernel when series
+# are irregular (the aligned tilestore path handles regular cadence)
+_PALLAS_FUNCS = frozenset({"rate", "increase", "delta"})
+
+
+@functools.partial(jax.jit, static_argnames=("func", "nsteps", "interpret"))
+def _pallas_rate_impl(func, nsteps, interpret, ts, vals, lens, w0s, w0e,
+                      step):
+    from filodb_tpu.query import pallas_kernels as pk
+
+    S, N = ts.shape
+    idx = jnp.arange(N)[None, :]
+    in_len = idx < lens[:, None]
+    is_counter = func != "delta"
+    v = vals + _correction(vals, lens) if is_counter else vals
+    tr = jnp.where(in_len, ts - w0s, pk.TR_PAD).astype(jnp.int32)
+    pay = pk.split3(jnp.where(in_len, v, 0.0)).astype(jnp.float32)
+    window = (w0e - w0s).astype(jnp.int32)
+    cnt, tlo, thi, plo, phi = pk.window_extract(
+        tr, pay, step.astype(jnp.int32), window, nsteps,
+        interpret=interpret)
+    t = jnp.arange(nsteps, dtype=jnp.int64)
+    wstart = w0s + t * step
+    wend = w0e + t * step
+    t1 = tlo.astype(jnp.int64) + w0s
+    t2 = thi.astype(jnp.int64) + w0s
+    v1 = pk.combine3(plo)
+    v2 = pk.combine3(phi)
+    out = _extrapolated_rate(wstart, wend, cnt, t1, v1, t2, v2,
+                             is_counter, func == "rate")
+    return jnp.where(cnt >= 1, out, jnp.nan)
+
+
+def _window_endpoint_pallas(func, ts, vals, lens, w0s, w0e, step, nsteps):
+    """Pallas boundary-extract path for rate/increase/delta. Returns None
+    when preconditions fail (range exceeds int32, or no compiled-TPU
+    backend and the problem is too big for interpret mode)."""
+    mask = np.arange(ts.shape[1])[None, :] < lens[:, None]
+    if not mask.any():
+        return None
+    t_min, t_max = int(ts[mask].min()), int(ts[mask].max())
+    span_ok = (abs(t_min - int(w0s)) < 2**31 - 2
+               and abs(t_max - int(w0s)) < 2**31 - 2
+               and int(w0e - w0s) + (nsteps - 1) * int(step) < 2**31 - 2)
+    if not span_ok:
+        return None
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu and ts.size > 262_144:
+        return None     # interpret mode is for small (test) shapes only
+    return _pallas_rate_impl(func, nsteps, not on_tpu,
+                             jnp.asarray(ts), jnp.asarray(vals),
+                             jnp.asarray(lens), jnp.asarray(w0s),
+                             jnp.asarray(w0e), jnp.asarray(step))
+
 
 class TpuBackend:
     """Pluggable device backend for QueryEngine (the ``--exec-backend=tpu``
@@ -335,6 +389,7 @@ class TpuBackend:
 
     def __init__(self, device: Optional[object] = None):
         self.device = device
+        self._tile_cache: Dict = {}
 
     def periodic_samples(self, series: Sequence[RawSeries],
                          params: RangeParams, function: str, window_ms: int,
@@ -353,6 +408,10 @@ class TpuBackend:
         if nsteps == 0:
             return GridResult(steps, keys,
                               np.empty((len(series), 0), dtype=np.float64))
+        aligned = self._try_aligned(series, func, steps, window_ms,
+                                    offset_ms, func_args)
+        if aligned is not None:
+            return GridResult(steps, keys, aligned)
         w0e = np.int64(steps[0] - offset_ms)
         w0s = np.int64(w0e - window_ms)
         step = np.int64(params.step_ms if nsteps > 1 else 1)
@@ -363,9 +422,60 @@ class TpuBackend:
             out = _window_gather(func, w_bound, ts, vals, lens,
                                  w0s, w0e, step, nsteps, scalar)
         else:
-            out = _window_endpoint(func, ts, vals, lens,
-                                   w0s, w0e, step, nsteps, scalar)
+            out = None
+            if func in _PALLAS_FUNCS:
+                out = _window_endpoint_pallas(func, ts, vals, lens, w0s,
+                                              w0e, step, nsteps)
+            if out is None:
+                out = _window_endpoint(func, ts, vals, lens,
+                                       w0s, w0e, step, nsteps, scalar)
         return GridResult(steps, keys, np.asarray(out))
+
+    _TILE_CACHE_MAX = 8
+
+    def _tile_entry(self, series):
+        """Cache of (tiles, idx, has_nan) per series snapshot. Keyed by the
+        ids of ALL series AND holding a reference to them (so ids cannot be
+        reused after GC); bounded FIFO."""
+        from filodb_tpu.query import tilestore as tst
+
+        key = tuple(id(s) for s in series)
+        entry = self._tile_cache.get(key)
+        if entry is None:
+            tiles, idx = tst.build_aligned_tiles(series)
+            has_nan = any(np.isnan(s.values).any() for s in series)
+            entry = (tiles, idx, has_nan, list(series))
+            if len(self._tile_cache) >= self._TILE_CACHE_MAX:
+                self._tile_cache.pop(next(iter(self._tile_cache)))
+            self._tile_cache[key] = entry
+        return entry
+
+    def _try_aligned(self, series, func: str, steps: np.ndarray,
+                     window_ms: int, offset_ms: int,
+                     func_args) -> Optional[np.ndarray]:
+        """Aligned-tile fast path (tilestore): regular-cadence series are
+        served with shared-column takes only; rows that don't align (or
+        funcs outside the aligned family) return None -> general path.
+        Tiles are cached per series-set identity so repeated queries over
+        the same store snapshot skip pack-time work."""
+        from filodb_tpu.query import tilestore as tst
+
+        if func not in tst.ALIGNED_FUNCS:
+            return None
+        tiles, idx, has_nan, _ = self._tile_entry(series)
+        if func == "last_sample" and has_nan:
+            return None     # stale markers must stay visible to the step
+        if tiles is None or len(idx) != len(series):
+            return None     # partial alignment: keep one result path
+        out = tst.evaluate_aligned(tiles, func, steps, window_ms,
+                                   offset_ms, func_args)
+        res = np.asarray(out)
+        if len(idx) != res.shape[0]:
+            return None
+        # restore original series order (build may drop/reorder rows)
+        full = np.empty((len(series), res.shape[1]), dtype=np.float64)
+        full[np.asarray(idx)] = res
+        return full
 
     @staticmethod
     def _window_sample_bound(series, window_ms: int, n_cap: int) -> int:
