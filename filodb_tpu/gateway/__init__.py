@@ -1,0 +1,3 @@
+"""Ingest gateway: test-data producers + Influx line protocol
+(reference: gateway/GatewayServer.scala, conversion/InfluxProtocolParser.scala,
+TestTimeseriesProducer)."""

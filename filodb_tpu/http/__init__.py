@@ -1,0 +1,6 @@
+"""HTTP API edge (reference: http/FiloHttpServer.scala:23,
+PrometheusApiRoute.scala:42, HealthRoute, ClusterApiRoute)."""
+
+from filodb_tpu.http.server import FiloHttpServer
+
+__all__ = ["FiloHttpServer"]

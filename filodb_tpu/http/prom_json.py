@@ -1,0 +1,82 @@
+"""Prometheus HTTP API JSON response shapes.
+
+(Reference: query/PromQueryResponse.scala + PromCirceSupport — the
+`{"status": "success", "data": {"resultType": ..., "result": [...]}}`
+envelope; NaN serialization follows the reference's remote-read behavior
+of stringified values, and absent samples are omitted from matrices like
+Prometheus does.)"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List
+
+import numpy as np
+
+from filodb_tpu.query.model import GridResult, ScalarResult
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def success(data: Any) -> Dict:
+    return {"status": "success", "data": data}
+
+
+def error(message: str, error_type: str = "bad_data",
+          status: str = "error") -> Dict:
+    return {"status": status, "errorType": error_type, "error": message}
+
+
+def matrix(grid: GridResult) -> Dict:
+    """Range-query result as resultType=matrix; NaN steps are omitted
+    (Prometheus staleness: absent sample, not NaN)."""
+    result: List[Dict] = []
+    steps_s = grid.steps / 1000.0
+    for i, key in enumerate(grid.keys):
+        row = grid.values[i]
+        ok = ~np.isnan(row)
+        if not ok.any():
+            continue
+        values = [[float(t), _fmt(v)]
+                  for t, v, o in zip(steps_s, row, ok) if o]
+        result.append({"metric": _metric(key), "values": values})
+    return success({"resultType": "matrix", "result": result})
+
+
+def vector(grid: GridResult) -> Dict:
+    """Instant-query result (single step) as resultType=vector."""
+    result: List[Dict] = []
+    t = float(grid.steps[-1]) / 1000.0 if grid.steps.size else 0.0
+    for i, key in enumerate(grid.keys):
+        v = grid.values[i, -1] if grid.values.size else np.nan
+        if np.isnan(v):
+            continue
+        result.append({"metric": _metric(key), "value": [t, _fmt(v)]})
+    return success({"resultType": "vector", "result": result})
+
+
+def scalar(res: ScalarResult, instant: bool) -> Dict:
+    if instant:
+        t = float(res.steps[-1]) / 1000.0
+        return success({"resultType": "scalar",
+                        "result": [t, _fmt(res.values[-1])]})
+    values = [[float(t) / 1000.0, _fmt(v)]
+              for t, v in zip(res.steps, res.values)]
+    return success({"resultType": "matrix",
+                    "result": [{"metric": {}, "values": values}]})
+
+
+def _metric(key: Dict[str, str]) -> Dict[str, str]:
+    out = {}
+    for k, v in key.items():
+        if k == "_metric_":
+            out["__name__"] = v
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,197 @@
+"""Threaded HTTP server exposing the Prometheus API over the memstore.
+
+Routes mirror the reference (http/PrometheusApiRoute.scala:48-129,
+HealthRoute.scala, ClusterApiRoute.scala):
+
+  GET/POST /promql/{dataset}/api/v1/query_range?query&start&end&step
+  GET/POST /promql/{dataset}/api/v1/query?query&time
+  GET      /promql/{dataset}/api/v1/labels
+  GET      /promql/{dataset}/api/v1/label/{name}/values
+  GET      /promql/{dataset}/api/v1/series?match[]=<selector>&start&end
+  GET      /__health | /__liveness
+  GET      /api/v1/cluster/{dataset}/status
+
+stdlib http.server (the JVM reference uses Akka-HTTP; the edge is not the
+hot path — all bulk compute is device-side behind QueryEngine)."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from filodb_tpu.http import prom_json
+from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
+                                      parse_query_range, selector_to_filters)
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.model import GridResult, QueryError, ScalarResult
+
+_ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
+
+
+class FiloHttpServer:
+    """Serves one or more datasets; each maps to a list of shards."""
+
+    def __init__(self, shards_by_dataset: Dict[str, list],
+                 backend: Optional[object] = None,
+                 shard_mapper: Optional[object] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.shards_by_dataset = shards_by_dataset
+        self.backend = backend
+        self.shard_mapper = shard_mapper
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet
+                pass
+
+            def do_GET(self):
+                outer._handle(self)
+
+            def do_POST(self):
+                outer._handle(self)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- request handling -------------------------------------------------
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        try:
+            parsed = urllib.parse.urlparse(req.path)
+            qs = urllib.parse.parse_qs(parsed.query)
+            if req.command == "POST":
+                ln = int(req.headers.get("Content-Length") or 0)
+                body = req.rfile.read(ln).decode() if ln else ""
+                ctype = req.headers.get("Content-Type", "")
+                if "application/x-www-form-urlencoded" in ctype:
+                    for k, v in urllib.parse.parse_qs(body).items():
+                        qs.setdefault(k, []).extend(v)
+            code, payload = self._route(parsed.path, qs)
+        except QueryError as e:
+            code, payload = 400, prom_json.error(str(e))
+        except Exception as e:   # noqa: BLE001 — edge must not crash
+            code, payload = 500, prom_json.error(str(e), "internal")
+        body = json.dumps(payload).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _route(self, path: str, qs: Dict):
+        if path in ("/__health", "/__liveness", "/__readiness"):
+            return 200, {"status": "healthy"}
+        m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
+        if m:
+            return 200, self._cluster_status(m.group("ds"))
+        m = _ROUTE.match(path)
+        if not m:
+            return 404, prom_json.error(f"no route for {path}", "not_found")
+        ds, rest = m.group("ds"), m.group("rest")
+        shards = self.shards_by_dataset.get(ds)
+        if shards is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
+        engine = QueryEngine(shards, backend=self.backend)
+        if rest == "query_range":
+            return self._query_range(engine, qs)
+        if rest == "query":
+            return self._query_instant(engine, qs)
+        if rest == "labels":
+            return self._labels(engine, qs)
+        lm = re.match(r"^label/(?P<name>[^/]+)/values$", rest)
+        if lm:
+            return self._label_values(engine, lm.group("name"), qs)
+        if rest == "series":
+            return self._series(engine, qs)
+        return 404, prom_json.error(f"no route for {path}", "not_found")
+
+    # -- endpoints --------------------------------------------------------
+    @staticmethod
+    def _param(qs, name, default=None):
+        v = qs.get(name)
+        return v[0] if v else default
+
+    def _query_range(self, engine, qs):
+        query = self._param(qs, "query")
+        if not query:
+            raise QueryError("missing query parameter")
+        start = int(float(self._param(qs, "start", "0")))
+        end = int(float(self._param(qs, "end", "0")))
+        step = int(float(self._param(qs, "step", "10")))
+        if end < start:
+            raise QueryError("end < start")
+        plan = parse_query_range(query, TimeStepParams(start, step, end))
+        res = engine.execute(plan)
+        if isinstance(res, ScalarResult):
+            return 200, prom_json.scalar(res, instant=False)
+        return 200, prom_json.matrix(res)
+
+    def _query_instant(self, engine, qs):
+        query = self._param(qs, "query")
+        if not query:
+            raise QueryError("missing query parameter")
+        time_s = int(float(self._param(qs, "time", "0")))
+        plan = parse_query(query, time_s)
+        res = engine.execute(plan)
+        if isinstance(res, ScalarResult):
+            return 200, prom_json.scalar(res, instant=True)
+        return 200, prom_json.vector(res)
+
+    def _time_range(self, qs):
+        start = int(float(self._param(qs, "start", "0"))) * 1000
+        end_raw = self._param(qs, "end")
+        end = (int(float(end_raw)) * 1000 if end_raw is not None
+               else 1 << 62)
+        return start, end
+
+    def _labels(self, engine, qs):
+        start, end = self._time_range(qs)
+        matches = qs.get("match[]", [])
+        filters = (selector_to_filters(matches[0]) if matches else ())
+        return 200, prom_json.success(
+            engine.execute(lp.LabelNames(list(filters), start, end)))
+
+    def _label_values(self, engine, name, qs):
+        start, end = self._time_range(qs)
+        matches = qs.get("match[]", [])
+        filters = (selector_to_filters(matches[0]) if matches else ())
+        return 200, prom_json.success(
+            engine.execute(lp.LabelValues(name, list(filters), start, end)))
+
+    def _series(self, engine, qs):
+        start, end = self._time_range(qs)
+        out = []
+        for sel in qs.get("match[]", []):
+            filters = selector_to_filters(sel)
+            for labels in engine.execute(
+                    lp.SeriesKeysByFilters(list(filters), start, end)):
+                out.append(prom_json._metric(labels))
+        return 200, prom_json.success(out)
+
+    def _cluster_status(self, ds):
+        """ClusterApiRoute status (ShardMapper snapshot)."""
+        if self.shard_mapper is None:
+            shards = self.shards_by_dataset.get(ds, [])
+            states = [{"shard": i, "status": "Active"}
+                      for i in range(len(shards))]
+        else:
+            states = [{"shard": i,
+                       "status": self.shard_mapper.status(i).value,
+                       "address": self.shard_mapper.node_of(i)}
+                      for i in range(self.shard_mapper.num_shards)]
+        return prom_json.success(states)

@@ -720,3 +720,13 @@ def parse_query(query: str, time_s: int,
     """Instant query at one timestamp (step=0 -> single step)."""
     return parse_query_range(query, TimeStepParams(time_s, 1, time_s),
                              lookback_ms)
+
+
+def selector_to_filters(selector: str) -> Tuple[ColumnFilter, ...]:
+    """Parse a bare series selector (`metric{label="x"}`) into column
+    filters — the HTTP `match[]` parameter (PrometheusApiRoute series/
+    labels endpoints)."""
+    ast = Parser(selector).parse()
+    if not isinstance(ast, Selector):
+        raise ValueError(f"not a series selector: {selector}")
+    return _matchers_to_filters(ast)

@@ -1,0 +1,6 @@
+"""Standalone server (reference: standalone/FiloServer.scala:112,
+NewFiloServerMain.scala:21)."""
+
+from filodb_tpu.standalone.server import FiloServer
+
+__all__ = ["FiloServer"]

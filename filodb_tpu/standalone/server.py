@@ -1,0 +1,129 @@
+"""FiloServer: the standalone node binary.
+
+Wires config -> memstore shards -> shard mapper -> TPU query backend ->
+HTTP API, mirroring the v2 startup path (standalone/NewFiloServerMain.scala:21:
+start memstore, discovery, ingestion, http) without Akka: shard state is a
+local ShardMapper FSM; the distributed query path is the mesh executor.
+
+Config keys follow conf/timeseries-dev-source.conf naming where sensible:
+  dataset, num-shards, groups-per-shard, max-chunks-size, port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, Optional
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.parallel.shardmapper import (ShardMapper,
+                                             assign_shards_evenly)
+
+DEFAULTS = {
+    "dataset": "timeseries",
+    "num-shards": 4,
+    "groups-per-shard": 8,
+    "max-chunks-size": 400,
+    "port": 8080,
+    "node-id": "node0",
+}
+
+
+class FiloServer:
+    def __init__(self, config: Optional[Dict] = None,
+                 backend: Optional[object] = None):
+        self.config = {**DEFAULTS, **(config or {})}
+        self.ref = DatasetRef(self.config["dataset"])
+        self.store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+        self.mapper = ShardMapper(self.config["num-shards"])
+        self.backend = backend
+        self.http: Optional[FiloHttpServer] = None
+
+    def start(self) -> "FiloServer":
+        n = self.config["num-shards"]
+        for shard in range(n):
+            self.store.setup(self.ref, shard,
+                             num_groups=self.config["groups-per-shard"],
+                             max_chunk_rows=self.config["max-chunks-size"])
+        assign_shards_evenly(self.mapper, [self.config["node-id"]])
+        for shard in range(n):
+            self.mapper.activate(shard)
+        if self.backend is None:
+            try:
+                from filodb_tpu.query.tpu import TpuBackend
+                self.backend = TpuBackend()
+            except Exception:            # device unavailable -> oracle
+                self.backend = None
+        self.http = FiloHttpServer(
+            {self.ref.dataset: self.store.shards(self.ref)},
+            backend=self.backend, shard_mapper=self.mapper,
+            port=self.config["port"])
+        self.http.start()
+        return self
+
+    def seed_dev_data(self, n_samples: int = 360, n_instances: int = 4,
+                      start_ms: Optional[int] = None) -> int:
+        """Dev loop seed (dev-gateway.sh + TestTimeseriesProducer)."""
+        from filodb_tpu.gateway.producer import (TestTimeseriesProducer,
+                                                 ingest_builders)
+        producer = TestTimeseriesProducer(
+            DEFAULT_SCHEMAS, num_shards=self.config["num-shards"])
+        if start_ms is None:
+            start_ms = (int(time.time()) - n_samples * 10) * 1000
+        rows = 0
+        rows += ingest_builders(self.store, self.ref,
+                                producer.gauges(start_ms, n_samples,
+                                                n_instances))
+        rows += ingest_builders(self.store, self.ref,
+                                producer.counters(start_ms, n_samples,
+                                                  n_instances))
+        rows += ingest_builders(self.store, self.ref,
+                                producer.histograms(start_ms, n_samples))
+        self.store.flush_all(self.ref)
+        return rows
+
+    def stop(self) -> None:
+        if self.http:
+            self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port if self.http else -1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="filodb-tpu-server")
+    p.add_argument("--config", help="JSON config file")
+    p.add_argument("--port", type=int)
+    p.add_argument("--num-shards", type=int)
+    p.add_argument("--dataset")
+    p.add_argument("--seed-dev-data", action="store_true",
+                   help="generate dev series on startup")
+    args = p.parse_args(argv)
+    config: Dict = {}
+    if args.config:
+        with open(args.config) as f:
+            config.update(json.load(f))
+    for k in ("port", "num_shards", "dataset"):
+        v = getattr(args, k)
+        if v is not None:
+            config[k.replace("_", "-")] = v
+    server = FiloServer(config).start()
+    if args.seed_dev_data:
+        rows = server.seed_dev_data()
+        print(f"seeded {rows} dev samples", file=sys.stderr)
+    print(f"filodb-tpu server listening on :{server.port}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

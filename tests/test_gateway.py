@@ -1,0 +1,95 @@
+"""Gateway tests: influx line protocol parsing + producer sharding
+(reference: gateway/src/test InfluxProtocolParserSpec shapes,
+TestTimeseriesProducer)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.gateway.influx import (InfluxParseError, parse_line,
+                                       parse_lines, record_to_builder)
+from filodb_tpu.gateway.producer import (TestTimeseriesProducer,
+                                         ingest_builders)
+from filodb_tpu.core.index import ColumnFilter
+
+
+def test_parse_basic_gauge_line():
+    r = parse_line(
+        "heap_usage,host=h0,dc=dc1 gauge=12.5 1600000000000000000")
+    assert r.measurement == "heap_usage"
+    assert r.tags == {"host": "h0", "dc": "dc1"}
+    assert r.fields == {"gauge": 12.5}
+    assert r.timestamp_ms == 1_600_000_000_000
+
+
+def test_parse_escapes_and_int_suffix():
+    r = parse_line(
+        r"my\ metric,tag\,x=a\ b counter=42i 1600000000000000000")
+    assert r.measurement == "my metric"
+    assert r.tags == {"tag,x": "a b"}
+    assert r.fields == {"counter": 42.0}
+
+
+def test_parse_missing_timestamp_uses_now():
+    r = parse_line("m value=1.0", now_ms=123_000)
+    assert r.timestamp_ms == 123_000
+
+
+@pytest.mark.parametrize("bad", ["justname", "m,badtag value=1 x y z",
+                                 "m novalue", "m f=abc"])
+def test_parse_errors(bad):
+    with pytest.raises(InfluxParseError):
+        parse_line(bad)
+
+
+def test_histogram_mapping_and_query():
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    used = record_to_builder(parse_line(
+        "lat,host=h0 sum=100.0,count=10,2=1,4=4,8=9,+Inf=10 "
+        "1600000000000000000"), b)
+    assert used == ["prom-histogram"]
+
+
+def test_counter_lines_end_to_end_query():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    ref = DatasetRef("ts")
+    store.setup(ref, 0)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    t0 = 1_600_000_000_000
+    lines = []
+    v = 0
+    for i in range(60):
+        v += 100
+        lines.append(f"reqs,host=h0 counter={v} {(t0 + i * 10_000) * 10**6}")
+    n = parse_lines("\n".join(lines), b)
+    assert n == 60
+    for c in b.containers():
+        store.ingest(ref, 0, c)
+    store.flush_all(ref)
+    parts = store.lookup_partitions(
+        ref, 0, [ColumnFilter.eq("_metric_", "reqs")], t0, t0 + 10**9)
+    assert len(parts) == 1
+
+
+def test_producer_shards_consistently():
+    p = TestTimeseriesProducer(DEFAULT_SCHEMAS, num_shards=8, spread=2)
+    labels = p._labels("heap_usage", 1)
+    s1 = p.shard_for("gauge", labels)
+    s2 = p.shard_for("gauge", labels)
+    assert s1 == s2 and 0 <= s1 < 8
+    builders = p.gauges(1_600_000_000_000, 30, n_instances=8)
+    assert sum(len(c) for b in builders.values()
+               for c in b.containers()) == 240
+
+
+def test_producer_ingest_roundtrip():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    ref = DatasetRef("ts")
+    for i in range(4):
+        store.setup(ref, i)
+    p = TestTimeseriesProducer(DEFAULT_SCHEMAS, num_shards=4)
+    rows = ingest_builders(store, ref,
+                           p.counters(1_600_000_000_000, 100))
+    assert rows == 400

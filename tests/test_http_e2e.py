@@ -1,0 +1,158 @@
+"""End-to-end HTTP slice: FiloServer startup -> seed dev data -> Prometheus
+API over a real socket (the reference dev loop: filodb-dev-start.sh +
+dev-gateway.sh + PrometheusApiRoute; parity model http/src/test
+PrometheusApiRouteSpec)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = FiloServer({"num-shards": 4, "port": 0}).start()
+    srv.seed_dev_data(n_samples=360, n_instances=4, start_ms=T0 * 1000)
+    yield srv
+    srv.stop()
+
+
+def _get(server, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{server.port}{path}"
+    if qs:
+        url += "?" + qs
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_health(server):
+    status, body = _get(server, "/__health")
+    assert status == 200 and body["status"] == "healthy"
+
+
+def test_cluster_status(server):
+    status, body = _get(server, "/api/v1/cluster/timeseries/status")
+    assert status == 200
+    assert len(body["data"]) == 4
+    assert all(s["status"] == "active" for s in body["data"])
+
+
+def test_query_range_rate(server):
+    end = T0 + 3600
+    status, body = _get(
+        server, "/promql/timeseries/api/v1/query_range",
+        query='rate(http_requests_total{job="test"}[5m])',
+        start=T0 + 600, end=end, step=60)
+    assert status == 200 and body["status"] == "success"
+    data = body["data"]
+    assert data["resultType"] == "matrix"
+    assert len(data["result"]) == 4            # 4 instances
+    # counter increases by (inst+1)*10 per 10s -> rate = (inst+1) * 1.0
+    by_inst = {r["metric"]["instance"]: r for r in data["result"]}
+    for inst in range(4):
+        r = by_inst[f"instance-{inst}"]
+        assert r["metric"]["__name__"] == "http_requests_total"
+        vals = np.array([float(v) for _, v in r["values"]])
+        np.testing.assert_allclose(vals, (inst + 1) * 1.0, rtol=1e-6)
+
+
+def test_query_range_aggregation(server):
+    status, body = _get(
+        server, "/promql/timeseries/api/v1/query_range",
+        query='sum(rate(http_requests_total[5m]))',
+        start=T0 + 600, end=T0 + 1200, step=60)
+    assert status == 200
+    res = body["data"]["result"]
+    assert len(res) == 1
+    vals = np.array([float(v) for _, v in res[0]["values"]])
+    np.testing.assert_allclose(vals, 10.0, rtol=1e-6)   # 1+2+3+4
+
+
+def test_instant_query_vector(server):
+    status, body = _get(
+        server, "/promql/timeseries/api/v1/query",
+        query="heap_usage", time=T0 + 1800)
+    assert status == 200
+    data = body["data"]
+    assert data["resultType"] == "vector"
+    assert len(data["result"]) == 4
+    for r in data["result"]:
+        t, v = r["value"]
+        assert t == T0 + 1800
+        assert 5.0 < float(v) < 25.0
+
+
+def test_instant_query_scalar(server):
+    status, body = _get(server, "/promql/timeseries/api/v1/query",
+                        query="42 + 1", time=T0)
+    assert status == 200
+    assert body["data"]["resultType"] == "scalar"
+    assert float(body["data"]["result"][1]) == 43.0
+
+
+def test_labels_and_label_values(server):
+    status, body = _get(server, "/promql/timeseries/api/v1/labels",
+                        start=T0, end=T0 + 3600)
+    assert status == 200
+    assert {"job", "instance", "host", "_ws_", "_ns_"} <= set(body["data"])
+    status, body = _get(server,
+                        "/promql/timeseries/api/v1/label/instance/values",
+                        start=T0, end=T0 + 3600)
+    assert body["data"] == [f"instance-{i}" for i in range(4)]
+
+
+def test_series_endpoint(server):
+    status, body = _get(server, "/promql/timeseries/api/v1/series",
+                        **{"match[]": 'heap_usage{instance="instance-1"}',
+                           "start": T0, "end": T0 + 3600})
+    assert status == 200
+    assert len(body["data"]) == 1
+    assert body["data"][0]["__name__"] == "heap_usage"
+
+
+def test_histogram_quantile_over_http(server):
+    status, body = _get(
+        server, "/promql/timeseries/api/v1/query_range",
+        query='histogram_quantile(0.9, '
+              'sum(rate(http_request_latency[5m])) by (le))',
+        start=T0 + 600, end=T0 + 1200, step=60)
+    assert status == 200
+    res = body["data"]["result"]
+    assert len(res) >= 1
+    vals = [float(v) for _, v in res[0]["values"]]
+    assert all(0.0 < x <= 64.0 for x in vals)
+
+
+def test_bad_query_returns_400(server):
+    status = None
+    try:
+        _get(server, "/promql/timeseries/api/v1/query_range",
+             query="rate(", start=T0, end=T0 + 60, step=60)
+    except urllib.error.HTTPError as e:
+        status = e.code
+        body = json.loads(e.read())
+        assert body["status"] == "error"
+    assert status in (400, 500)
+
+
+def test_unknown_dataset_400(server):
+    try:
+        _get(server, "/promql/nope/api/v1/query", query="x", time=T0)
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_unknown_route_404(server):
+    try:
+        _get(server, "/promql/timeseries/api/v1/bogus")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
