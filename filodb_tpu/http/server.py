@@ -21,7 +21,7 @@ import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from filodb_tpu.http import prom_json
 from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
@@ -160,27 +160,37 @@ class FiloHttpServer:
         return start, end
 
     def _labels(self, engine, qs):
+        # Prometheus semantics: result is the UNION over all match[]
+        # selectors (none -> all series).
         start, end = self._time_range(qs)
-        matches = qs.get("match[]", [])
-        filters = (selector_to_filters(matches[0]) if matches else ())
-        return 200, prom_json.success(
-            engine.execute(lp.LabelNames(list(filters), start, end)))
+        out: set = set()
+        for sel in qs.get("match[]", []) or [None]:
+            filters = selector_to_filters(sel) if sel else ()
+            out.update(engine.execute(lp.LabelNames(list(filters),
+                                                    start, end)))
+        return 200, prom_json.success(sorted(out))
 
     def _label_values(self, engine, name, qs):
         start, end = self._time_range(qs)
-        matches = qs.get("match[]", [])
-        filters = (selector_to_filters(matches[0]) if matches else ())
-        return 200, prom_json.success(
-            engine.execute(lp.LabelValues(name, list(filters), start, end)))
+        out: set = set()
+        for sel in qs.get("match[]", []) or [None]:
+            filters = selector_to_filters(sel) if sel else ()
+            out.update(engine.execute(lp.LabelValues(name, list(filters),
+                                                     start, end)))
+        return 200, prom_json.success(sorted(out))
 
     def _series(self, engine, qs):
         start, end = self._time_range(qs)
         out = []
+        seen = set()
         for sel in qs.get("match[]", []):
             filters = selector_to_filters(sel)
             for labels in engine.execute(
                     lp.SeriesKeysByFilters(list(filters), start, end)):
-                out.append(prom_json._metric(labels))
+                key = frozenset(labels.items())
+                if key not in seen:
+                    seen.add(key)
+                    out.append(prom_json._metric(labels))
         return 200, prom_json.success(out)
 
     def _cluster_status(self, ds):

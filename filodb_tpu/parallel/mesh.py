@@ -105,10 +105,18 @@ def _grouped_reduce(local: jnp.ndarray, gids: jnp.ndarray, num_groups: int,
     Sum-family runs as a one-hot [S,G] matmul (MXU); min/max as segment
     reductions; NaN (stale/empty) entries contribute nothing. Mean is
     sum/count reduced separately (AvgRowAggregator keeps (mean, count)
-    pairs — same math, batched)."""
-    ok = ~jnp.isnan(local)
-    onehot = (gids[:, None] == jnp.arange(num_groups)[None, :]
-              ).astype(local.dtype)                    # [S, G]
+    pairs — same math, batched).
+
+    Padding rows carry the sentinel gid -1: their one-hot row is all-zero
+    and their entries are masked out, so functions that map empty rows to
+    non-NaN values (absent_over_time -> 1.0) cannot contaminate group 0,
+    while a REAL series with zero samples still aggregates normally."""
+    valid = (gids >= 0)[:, None]                       # [S, 1]
+    ok = ~jnp.isnan(local) & valid
+    local = jnp.where(valid, local, jnp.nan)
+    gids = jnp.where(valid[:, 0], gids, 0)
+    onehot = ((gids[:, None] == jnp.arange(num_groups)[None, :])
+              & valid).astype(local.dtype)             # [S, G]
     cnt = onehot.T @ ok.astype(local.dtype)            # [G, T]
     cnt = jax.lax.psum(cnt, "shard")
     if agg == "count":
@@ -205,7 +213,7 @@ class MeshExecutor:
         ts, vals, lens, _ = pack_sharded(series_by_shard,
                                          drop_nan=(func != "last_sample"))
         G, S, _ = ts.shape
-        gids = np.zeros((G, S), dtype=np.int32)
+        gids = np.full((G, S), -1, dtype=np.int32)   # -1 marks padding rows
         for g, row in enumerate(group_ids_by_shard):
             gids[g, :len(row)] = row
         steps = params.steps

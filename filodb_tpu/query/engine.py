@@ -397,21 +397,50 @@ def binary_join(lhs: GridResult, rhs: GridResult, op: str,
     if op in ("and", "or", "unless"):
         return _set_op(lhs, rhs, op, on, ignoring)
 
-    # determine "one" side for many-to-one/one-to-many
-    if cardinality == "one-to-many":
-        # mirror: swap so the many side is lhs, then swap op operand order
-        swapped = binary_join(rhs, lhs, _swap_op(op), "many-to-one", on,
-                              ignoring, include, return_bool)
-        return swapped
+    # grouped joins: evaluate in-place with the ORIGINAL operand order —
+    # swapping sides is wrong for non-commutative ops (-,/,^,%,atan2) —
+    # output labels come from the "many" side (group_left: lhs is many,
+    # group_right: rhs is many), include labels copied from the "one" side.
+    if cardinality in ("many-to-one", "one-to-many"):
+        many, one = ((lhs, rhs) if cardinality == "many-to-one"
+                     else (rhs, lhs))
+        omap: Dict[Tuple, int] = {}
+        for j, k in enumerate(one.keys):
+            key = _join_key(k, on, ignoring)
+            if key in omap:
+                raise QueryError(
+                    "many-to-many join: duplicate series on 'one' side")
+            omap[key] = j
+        out_keys = []
+        rows = []
+        for i, k in enumerate(many.keys):
+            key = _join_key(k, on, ignoring)
+            j = omap.get(key)
+            if j is None:
+                continue
+            if cardinality == "many-to-one":
+                a, b = lhs.values[i], rhs.values[j]
+            else:
+                a, b = lhs.values[j], rhs.values[i]
+            out = _apply_op(op, a, b, return_bool)
+            labels = dict(strip_metric(k))
+            for l in include:
+                if l in one.keys[j]:
+                    labels[l] = one.keys[j][l]
+                else:
+                    labels.pop(l, None)
+            rows.append(out)
+            out_keys.append(labels)
+        values = np.vstack(rows) if rows else np.zeros((0, steps.size))
+        return GridResult(steps, out_keys, values)
 
     rmap: Dict[Tuple, List[int]] = {}
     for j, k in enumerate(rhs.keys):
         rmap.setdefault(_join_key(k, on, ignoring), []).append(j)
-    if cardinality == "one-to-one":
-        for key, js in rmap.items():
-            if len(js) > 1:
-                raise QueryError(
-                    "many-to-many join: duplicate series on right side")
+    for key, js in rmap.items():
+        if len(js) > 1:
+            raise QueryError(
+                "many-to-many join: duplicate series on right side")
     out_keys: List[Dict[str, str]] = []
     rows: List[np.ndarray] = []
     seen_left: Dict[Tuple, int] = {}
@@ -420,31 +449,17 @@ def binary_join(lhs: GridResult, rhs: GridResult, op: str,
         js = rmap.get(key)
         if not js:
             continue
-        if cardinality == "one-to-one":
-            if key in seen_left:
-                raise QueryError(
-                    "many-to-many join: duplicate series on left side")
-            seen_left[key] = i
+        if key in seen_left:
+            raise QueryError(
+                "many-to-many join: duplicate series on left side")
+        seen_left[key] = i
         j = js[0]
         a, b = lhs.values[i], rhs.values[j]
         out = _apply_op(op, a, b, return_bool)
-        labels = strip_metric(k) if not return_bool else strip_metric(k)
-        if cardinality == "many-to-one" and include:
-            for l in include:
-                if l in rhs.keys[j]:
-                    labels = dict(labels)
-                    labels[l] = rhs.keys[j][l]
         rows.append(out)
-        out_keys.append(dict(labels))
+        out_keys.append(dict(strip_metric(k)))
     values = np.vstack(rows) if rows else np.zeros((0, steps.size))
     return GridResult(steps, out_keys, values)
-
-
-def _swap_op(op: str) -> str:
-    swaps = {">": "<", "<": ">", ">=": "<=", "<=": ">=", "-": "-", "/": "/"}
-    # for commutative ops the same op works; for - and / we must NOT swap
-    # operands blindly — handled by caller semantics; keep simple:
-    return {">": "<", "<": ">", ">=": "<=", "<=": ">="}.get(op, op)
 
 
 def _set_op(lhs: GridResult, rhs: GridResult, op: str,

@@ -132,10 +132,18 @@ def _sum_family(reducer: str):
             elif reducer == "avg":
                 out = s / counts
             else:
-                cs2 = np.concatenate([[0.0], np.cumsum(vals * vals)])
+                # shifted squares: prefix sums of (x-c)^2 with c = series
+                # mean keep full precision when |mean| >> stddev (Prometheus
+                # computes this with Welford; the shifted prefix form is
+                # algebraically identical and windowable)
+                finite = vals[np.isfinite(vals)]
+                shift = finite.mean() if finite.size else 0.0
+                d = vals - shift
+                cs2 = np.concatenate([[0.0], np.cumsum(d * d)])
                 s2 = cs2[np.clip(hi + 1, 0, n)] - cs2[np.clip(lo, 0, n)]
                 mean = s / counts
-                var = np.maximum(s2 / counts - mean * mean, 0.0)
+                dm = mean - shift
+                var = np.maximum(s2 / counts - dm * dm, 0.0)
                 if reducer == "stdvar":
                     out = var
                 elif reducer == "stddev":

@@ -94,8 +94,12 @@ class AlignedTiles:
             c = v
         elif name == "ones":
             c = valid.astype(jnp.float64)
-        elif name == "v2":
-            c = v * v
+        elif name == "vc2":
+            # squared deviation from a per-series shift (the series mean):
+            # windowed variance from prefix sums of (x-c)^2 avoids the
+            # catastrophic cancellation of the E[x^2]-mean^2 form
+            d = jnp.where(valid, v - self.vshift[:, None], 0.0)
+            c = d * d
         elif name == "ts":
             c = jnp.where(valid, self.ts, 0.0)
         elif name == "cv":                      # counter-reset corrected
@@ -119,6 +123,17 @@ class AlignedTiles:
         else:
             raise KeyError(name)
         self._channels[name] = c
+        return c
+
+    @property
+    def vshift(self) -> jnp.ndarray:
+        """Per-series shift for stable variance: mean of valid samples."""
+        c = self._channels.get("_vshift")
+        if c is None:
+            okf = self.valid & jnp.isfinite(self.vals)
+            cnt = jnp.maximum(okf.sum(axis=1), 1)
+            c = jnp.where(okf, self.vals, 0.0).sum(axis=1) / cnt
+            self._channels["_vshift"] = c
         return c
 
     def ff(self, name: str) -> jnp.ndarray:
@@ -343,8 +358,8 @@ _ENDPOINT_CH = {
 _PREFIX_CH = {
     "sum_over_time": ["v"], "avg_over_time": ["v"],
     "rate_over_delta": ["v"], "increase_over_delta": ["v"],
-    "stddev_over_time": ["v", "v2"], "stdvar_over_time": ["v", "v2"],
-    "z_score": ["v", "v2"], "changes": ["ev_change"],
+    "stddev_over_time": ["v", "vc2"], "stdvar_over_time": ["v", "vc2"],
+    "z_score": ["v", "vc2"], "changes": ["ev_change"],
     "resets": ["ev_reset"],
 }
 
@@ -373,6 +388,8 @@ def _tiles_arrays(tiles: AlignedTiles, func: str) -> Dict[str, jnp.ndarray]:
     for n in _PREFIX_CH.get(func, ()):
         arrs["ps_" + n] = tiles.prefix(n)
         arrs["ch_" + n] = tiles.channel(n)
+    if "vc2" in _PREFIX_CH.get(func, ()):
+        arrs["vshift"] = tiles.vshift
     return arrs
 
 
@@ -435,9 +452,10 @@ def _eval_core(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
     elif func == "avg_over_time":
         out = s / counts
     else:
-        s2 = _window_sum(arrs, "v2", N, k_lo, k_hi, wstart, wend)
+        s2 = _window_sum(arrs, "vc2", N, k_lo, k_hi, wstart, wend)
         mean = s / counts
-        var = jnp.maximum(s2 / counts - mean * mean, 0.0)
+        dmean = mean - arrs["vshift"][:, None]
+        var = jnp.maximum(s2 / counts - dmean * dmean, 0.0)
         if func == "stdvar_over_time":
             out = var
         elif func == "stddev_over_time":

@@ -259,3 +259,75 @@ def test_and_or_unless():
     assert finite == ["b"]
     res = run(shard, "m2 or m1")
     assert {k["host"] for k in res.keys} == {"a", "b"}
+
+
+def test_binary_join_group_left_noncommutative():
+    """many OP one keeps operand order (BinaryJoinExecSpec group_left).
+
+    Values at step 0 (60s into the data): many m{mode}=3, one o=2."""
+    shard = make_shard()
+    ingest_gauges(shard, [({"job": "api", "mode": "r"}, -57.0),
+                          ({"job": "api", "mode": "w"}, -57.0)], metric="m")
+    ingest_gauges(shard, [({"job": "api"}, -58.0)], metric="o")
+    for op, want in [("-", 1.0), ("/", 1.5), ("^", 9.0), ("%", 1.0)]:
+        res = run(shard, f"m {op} on (job) group_left o")
+        assert res.num_series == 2, op
+        for i in range(2):
+            assert res.values[i][0] == pytest.approx(want), op
+        assert {k["mode"] for k in res.keys} == {"r", "w"}
+
+
+def test_binary_join_group_right_noncommutative():
+    """one OP many must compute one/many, not many/one (the round-1 bug:
+    reference BinaryJoinExec.scala:58 one-to-many semantics)."""
+    shard = make_shard()
+    ingest_gauges(shard, [({"job": "api", "mode": "r"}, -57.0),
+                          ({"job": "api", "mode": "w"}, -57.0)], metric="m")
+    ingest_gauges(shard, [({"job": "api"}, -58.0)], metric="o")
+    for op, want in [("-", -1.0), ("/", 2.0 / 3.0), ("^", 8.0),
+                     ("%", 2.0)]:
+        res = run(shard, f"o {op} on (job) group_right m")
+        assert res.num_series == 2, op
+        for i in range(2):
+            assert res.values[i][0] == pytest.approx(want), op
+        # output labels come from the many (rhs) side
+        assert {k["mode"] for k in res.keys} == {"r", "w"}
+
+
+def test_binary_join_group_left_include_labels():
+    shard = make_shard()
+    ingest_gauges(shard, [({"job": "api", "mode": "r"}, 0.0)], metric="m")
+    ingest_gauges(shard, [({"job": "api", "version": "v9"}, 1.0)],
+                  metric="o")
+    res = run(shard, "m * on (job) group_left (version) o")
+    assert res.num_series == 1
+    assert res.keys[0].get("version") == "v9"
+
+
+def test_labels_api_match_union():
+    """labels/label-values union across multiple match[] selectors
+    (PrometheusApiRoute semantics; round-1 only honored matches[0])."""
+    import json
+    import urllib.request
+
+    from filodb_tpu.http.server import FiloHttpServer
+    from filodb_tpu.query.tpu import TpuBackend
+
+    shard = make_shard()
+    ingest_gauges(shard, [({"host": "a"}, 1.0)], metric="m1")
+    ingest_gauges(shard, [({"zone": "z"}, 1.0)], metric="m2")
+    srv = FiloHttpServer({"timeseries": [shard]}, backend=None, port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1"
+        q = "match%5B%5D=m1&match%5B%5D=m2"
+        labels = json.load(urllib.request.urlopen(f"{base}/labels?{q}"))
+        assert "host" in labels["data"] and "zone" in labels["data"]
+        vals = json.load(urllib.request.urlopen(
+            f"{base}/label/_metric_/values?{q}"))
+        assert set(vals["data"]) >= {"m1", "m2"}
+        series = json.load(urllib.request.urlopen(
+            f"{base}/series?match%5B%5D=m1&match%5B%5D=m1"))
+        assert len(series["data"]) == 1  # deduped across selectors
+    finally:
+        srv.stop()

@@ -187,3 +187,19 @@ def test_shards_for_ordinal():
     assert allsh == list(range(16))
     with pytest.raises(ValueError):
         shards_for_ordinal(4, 4, 16)
+
+
+def test_mesh_absent_over_time_padding_not_counted(mesh8):
+    """Padding rows must not leak absent_over_time=1.0 into group 0
+    (round-1 advisor finding: padding gids defaulted to 0)."""
+    # shard 0 has 3 series (padded to pow2=4), all with data in-window
+    by_shard = [_mk_series(5, 3)] + [[] for _ in range(7)]
+    gids = [[0, 0, 0]] + [[] for _ in range(7)]
+    ex = mesh8
+    out = ex.window_aggregate(by_shard, PARAMS, "absent_over_time",
+                              WINDOW, "sum", gids, 1)
+    # every real series has samples in every window => absent sums to NaN
+    assert np.all(np.isnan(out[0]))
+    cnt = ex.window_aggregate(by_shard, PARAMS, "present_over_time",
+                              WINDOW, "count", gids, 1)
+    assert np.nanmax(cnt[0]) == 3.0

@@ -166,3 +166,28 @@ def test_tile_cache_reused_across_queries():
     assert len(be._tile_cache) == 1
     be.periodic_samples(series, PARAMS, "avg_over_time", WINDOW)
     assert len(be._tile_cache) == 1       # same snapshot, no rebuild
+
+
+@pytest.mark.parametrize("func", ["stddev_over_time", "stdvar_over_time",
+                                  "z_score"])
+def test_variance_large_offset_no_cancellation(func):
+    """Variance via shifted squares must survive a large mean offset
+    (round-1 advisor: E[x^2]-mean^2 diverged ~1e-7 and z_score NaN'd).
+
+    Values ~1e8 with O(1) spread: the naive form loses all 8 digits of
+    the variance; the shifted form keeps full precision."""
+    rng = np.random.default_rng(11)
+    series = []
+    for i in range(4):
+        ts = np.arange(1, 151, dtype=np.int64) * DT
+        vals = 1e8 + rng.normal(0.0, 2.0, 150)
+        series.append(RawSeries({"i": str(i)}, ts, vals))
+    got = _device(series, func)
+    want = _oracle(series, func)
+    # z_score's numerator (last - mean) cancels at 1e8 scale in BOTH
+    # paths; allow for op-ordering noise there
+    rtol = 5e-6 if func == "z_score" else 1e-6
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=1e-9,
+                               equal_nan=True)
+    # sanity: results are finite wherever the oracle is
+    assert np.isnan(got).sum() == np.isnan(want).sum()
