@@ -77,7 +77,7 @@ class TimeSeriesPartition:
 
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
-                 "ingested", "ooo_dropped")
+                 "ingested", "ooo_dropped", "_decode_cache")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -92,6 +92,8 @@ class TimeSeriesPartition:
         self._chunk_seq = 0
         self.ingested = 0
         self.ooo_dropped = 0
+        # col_index -> [n_chunks_decoded, ts_parts, val_parts, concat pair]
+        self._decode_cache: Dict[int, list] = {}
 
     # -- write path -------------------------------------------------------
     def ingest(self, timestamp: int, values: Sequence) -> bool:
@@ -166,6 +168,70 @@ class TimeSeriesPartition:
         return (np.asarray(self._ts_buf, dtype=np.int64),
                 [list(b) for b in self._col_bufs])
 
+    def _decoded_chunk_arrays(self, col_index: int
+                              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Decoded concatenation of all PUBLISHED chunks for one column,
+        cached incrementally: only chunks appended since the last call are
+        decoded. This is the host mirror of the device tile store — decode
+        cost is paid once per chunk, not once per query."""
+        col = self.schema.columns[col_index]
+        entry = self._decode_cache.get(col_index)
+        if entry is None:
+            entry = [0, [], [], None]
+            self._decode_cache[col_index] = entry
+        if entry[0] < len(self.chunks):
+            for c in self.chunks[entry[0]:]:
+                entry[1].append(bv.decode_longs(c.vectors[0]))
+                if col.col_type == ColumnType.HISTOGRAM:
+                    _, _, vals = bh.decode_histograms(c.vectors[col_index])
+                else:
+                    vals = bv.decode_doubles(c.vectors[col_index])
+                entry[2].append(vals)
+            entry[0] = len(self.chunks)
+            entry[3] = None
+        if entry[3] is None:
+            if entry[1]:
+                cat = (np.concatenate(entry[1]),
+                       np.concatenate(entry[2], axis=0))
+                # collapse parts into the concatenation (no 2x residency);
+                # future chunks append after it
+                entry[1] = [cat[0]]
+                entry[2] = [cat[1]]
+            else:
+                col_empty = (np.zeros((0, 0))
+                             if col.col_type == ColumnType.HISTOGRAM
+                             else np.zeros(0))
+                cat = (np.zeros(0, dtype=np.int64), col_empty)
+            # cache-backed arrays are shared with query results: freeze them
+            for a in cat:
+                a.setflags(write=False)
+            entry[3] = cat
+        return entry[3]
+
+    def read_full(self, col_index: int
+                  ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """All samples of one data column: published chunks (cached decode)
+        + current write-buffer tail. Returns (ts, vals, chunk_len) where
+        chunk_len is the length of the chunk-backed (immutable) prefix —
+        downstream device caches key on it (num_chunks pins its content)."""
+        col = self.schema.columns[col_index]
+        cts, cvals = self._decoded_chunk_arrays(col_index)
+        buf_ts, buf_cols = self.buffer_snapshot()
+        if not buf_ts.size:
+            return cts, cvals, cts.size
+        if col.col_type == ColumnType.HISTOGRAM:
+            rows = buf_cols[col_index - 1]
+            tail = (np.stack(rows).astype(np.float64) if rows
+                    else np.zeros((0, cvals.shape[1]
+                                   if cvals.ndim == 2 else 0)))
+            if cvals.ndim == 2 and tail.ndim == 2 \
+                    and cvals.shape[1] != tail.shape[1] and cvals.size == 0:
+                cvals = np.zeros((0, tail.shape[1]))
+        else:
+            tail = np.asarray(buf_cols[col_index - 1], dtype=np.float64)
+        return (np.concatenate([cts, buf_ts]),
+                np.concatenate([cvals, tail], axis=0), cts.size)
+
     def read_range(self, start_ts: int, end_ts: int, col_index: int
                    ) -> Tuple[np.ndarray, np.ndarray]:
         """All samples with start_ts <= t <= end_ts for one data column.
@@ -174,39 +240,10 @@ class TimeSeriesPartition:
         Merges immutable chunks with the current write-buffer snapshot — the
         equivalent of the reference's RawDataRangeVector iteration over
         ChunkMap + appenders (TimeSeriesPartition readers)."""
-        col = self.schema.columns[col_index]
-        ts_parts: List[np.ndarray] = []
-        val_parts: List[np.ndarray] = []
-        for c in self.chunks:
-            if c.end_ts < start_ts or c.start_ts > end_ts:
-                continue
-            ts = bv.decode_longs(c.vectors[0])
-            if col.col_type == ColumnType.HISTOGRAM:
-                _, _, vals = bh.decode_histograms(c.vectors[col_index])
-            else:
-                vals = bv.decode_doubles(c.vectors[col_index])
-            ts_parts.append(ts)
-            val_parts.append(vals)
-        buf_ts, buf_cols = self.buffer_snapshot()
-        if buf_ts.size:
-            ts_parts.append(buf_ts)
-            if col.col_type == ColumnType.HISTOGRAM:
-                rows = buf_cols[col_index - 1]
-                val_parts.append(
-                    np.stack(rows).astype(np.float64) if rows
-                    else np.zeros((0, 0)))
-            else:
-                val_parts.append(
-                    np.asarray(buf_cols[col_index - 1], dtype=np.float64))
-        if not ts_parts:
-            nb = 0
-            empty_vals = (np.zeros((0, nb)) if col.col_type ==
-                          ColumnType.HISTOGRAM else np.zeros(0))
-            return np.zeros(0, dtype=np.int64), empty_vals
-        ts_all = np.concatenate(ts_parts)
-        val_all = np.concatenate(val_parts, axis=0)
-        m = (ts_all >= start_ts) & (ts_all <= end_ts)
-        return ts_all[m], val_all[m]
+        ts_all, val_all, _ = self.read_full(col_index)
+        lo = int(np.searchsorted(ts_all, start_ts, side="left"))
+        hi = int(np.searchsorted(ts_all, end_ts, side="right"))
+        return ts_all[lo:hi], val_all[lo:hi]
 
     @property
     def num_chunks(self) -> int:

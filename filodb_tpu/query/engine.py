@@ -44,10 +44,16 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                       filters: Sequence[ColumnFilter],
                       start_ms: int, end_ms: int,
                       column: Optional[str] = None,
-                      stats: Optional[QueryStats] = None) -> List[RawSeries]:
+                      stats: Optional[QueryStats] = None,
+                      full: bool = False) -> List[RawSeries]:
     """Gather raw samples for all matching series across shards
     (SelectRawPartitionsExec.scala:159 doExecute; schema resolved per
-    partition like MultiSchemaPartitionsExec)."""
+    partition like MultiSchemaPartitionsExec).
+
+    ``full=True`` reads each matched partition's WHOLE series (cached chunk
+    decode + buffer tail) and attaches store snapshot keys; the windowing
+    path uses this so device tile caches hit across queries — the step grid
+    itself restricts the evaluation to the query range."""
     out: List[RawSeries] = []
     for shard in shards:
         for part in shard.lookup_partitions(filters, start_ms, end_ms):
@@ -59,7 +65,13 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                 raise QueryError(
                     f"schema {schema.name} has no column {col_name}")
             col = schema.columns[ci]
-            ts, vals = part.read_range(start_ms, end_ms, ci)
+            if full:
+                ts, vals, chunk_len = part.read_full(ci)
+                snap = (shard.ref.dataset, shard.shard_num, part.part_id,
+                        part.num_chunks, ci)
+            else:
+                ts, vals = part.read_range(start_ms, end_ms, ci)
+                chunk_len, snap = -1, None
             les = None
             if col.col_type == ColumnType.HISTOGRAM:
                 les = part._hist_scheme.les() if part._hist_scheme is not None \
@@ -69,10 +81,34 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                 ts=ts, values=vals,
                 is_counter=col.is_counter_like,
                 bucket_les=les,
+                snapshot_key=snap,
+                chunk_len=chunk_len if full else -1,
             ))
             if stats is not None:
                 stats.series_scanned += 1
-                stats.samples_scanned += int(ts.size)
+                if full:
+                    lo = int(np.searchsorted(ts, start_ms, side="left"))
+                    hi = int(np.searchsorted(ts, end_ms, side="right"))
+                    stats.samples_scanned += hi - lo
+                else:
+                    stats.samples_scanned += int(ts.size)
+    return out
+
+
+def clip_series(series: Sequence[RawSeries], start_ms: int, end_ms: int
+                ) -> List[RawSeries]:
+    """Restrict each series to samples in [start_ms, end_ms] (views, no
+    copies). Used to hand the oracle / general device path only the span a
+    window grid can touch, while tile caches keep the full snapshot."""
+    out = []
+    for s in series:
+        lo = int(np.searchsorted(s.ts, start_ms, side="left"))
+        hi = int(np.searchsorted(s.ts, end_ms, side="right"))
+        if lo == 0 and hi == s.ts.size:
+            out.append(s)
+        else:
+            out.append(RawSeries(s.labels, s.ts[lo:hi], s.values[lo:hi],
+                                 s.is_counter, s.bucket_les))
     return out
 
 
@@ -827,14 +863,16 @@ class QueryEngine:
         fetch_end = end_ms - offset_ms if offset_ms else end_ms
         series = select_raw_series(
             self.shards, raw.filters, fetch_start, fetch_end, raw.column,
-            self.stats)
+            self.stats, full=True)
         params = RangeParams(start_ms, step_ms, end_ms)
         if self.backend is not None and function is not None:
             out = self.backend.periodic_samples(
                 series, params, function, window_ms, func_args, offset_ms)
             if out is not None:
                 return out
-        return periodic_samples(series, params, function, window_ms,
+        # oracle fallback: evaluate only over the span the grid can touch
+        return periodic_samples(clip_series(series, fetch_start, fetch_end),
+                                params, function, window_ms,
                                 func_args, offset_ms)
 
     def _subquery(self, plan: lp.SubqueryWithWindowing) -> GridResult:

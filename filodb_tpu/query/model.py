@@ -40,12 +40,22 @@ class RangeParams:
 
 @dataclass
 class RawSeries:
-    """One series' raw samples (RawDataRangeVector equivalent)."""
+    """One series' raw samples (RawDataRangeVector equivalent).
+
+    ``snapshot_key`` identifies the immutable chunk-backed prefix of this
+    series in its store — (dataset, shard, part_id, num_chunks). Device tile
+    caches key on it: the prefix content is pinned by num_chunks (chunks are
+    append-only and immutable), so repeated queries over an unchanged store
+    snapshot reuse device tiles with zero rebuilds. ``chunk_len`` is the
+    length of that prefix; samples beyond it are the mutable write-buffer
+    tail (merged host-side / via the general path at query time)."""
     labels: Mapping[str, str]
     ts: np.ndarray          # int64 ms, sorted
     values: np.ndarray      # f64 [n] or f64 [n, num_buckets] for histograms
     is_counter: bool = False
     bucket_les: Optional[np.ndarray] = None  # for histogram series
+    snapshot_key: Optional[Tuple] = None
+    chunk_len: int = -1     # -1: everything is immutable (no tail)
 
 
 @dataclass

@@ -390,6 +390,7 @@ class TpuBackend:
     def __init__(self, device: Optional[object] = None):
         self.device = device
         self._tile_cache: Dict = {}
+        self.tile_builds = 0    # observability: device tile (re)builds
 
     def periodic_samples(self, series: Sequence[RawSeries],
                          params: RangeParams, function: str, window_ms: int,
@@ -408,13 +409,29 @@ class TpuBackend:
         if nsteps == 0:
             return GridResult(steps, keys,
                               np.empty((len(series), 0), dtype=np.float64))
-        aligned = self._try_aligned(series, func, steps, window_ms,
-                                    offset_ms, func_args)
+        aligned = self._try_aligned(series, func, steps, params.step_ms,
+                                    window_ms, offset_ms, func_args)
         if aligned is not None:
             return GridResult(steps, keys, aligned)
+        out = self._general(series, func, steps, params.step_ms, window_ms,
+                            offset_ms, func_args)
+        return GridResult(steps, keys, out)
+
+    def _general(self, series, func: str, steps: np.ndarray, step_ms: int,
+                 window_ms: int, offset_ms: int, func_args) -> np.ndarray:
+        """General packed path (any cadence): fused window kernels over
+        padded [S, N] tiles. ``steps`` may be any contiguous slice of a
+        uniform grid."""
+        from filodb_tpu.query.engine import clip_series
+
+        nsteps = steps.size
         w0e = np.int64(steps[0] - offset_ms)
         w0s = np.int64(w0e - window_ms)
-        step = np.int64(params.step_ms if nsteps > 1 else 1)
+        step = np.int64(step_ms if nsteps > 1 else 1)
+        # pack only the span the grid can touch — series may carry the whole
+        # retention (select full=True for tile caching)
+        series = clip_series(series, int(w0s),
+                             int(steps[-1] - offset_ms))
         ts, vals, lens = pack_series(series, drop_nan=(func != "last_sample"))
         scalar = float(func_args[0]) if func_args else 0.0
         if func in _GATHER_FUNCS:
@@ -429,52 +446,97 @@ class TpuBackend:
             if out is None:
                 out = _window_endpoint(func, ts, vals, lens,
                                        w0s, w0e, step, nsteps, scalar)
-        return GridResult(steps, keys, np.asarray(out))
+        return np.asarray(out)
 
-    _TILE_CACHE_MAX = 8
+    _TILE_CACHE_MAX = 16
+
+    @staticmethod
+    def _prefix_len(s) -> int:
+        return s.chunk_len if s.chunk_len >= 0 else s.ts.size
 
     def _tile_entry(self, series):
-        """Cache of (tiles, idx, has_nan) per series snapshot. Keyed by the
-        ids of ALL series AND holding a reference to them (so ids cannot be
-        reused after GC); bounded FIFO."""
+        """Cache of (tiles, idx) built over each series' IMMUTABLE chunk
+        prefix. Keyed by store snapshot keys when the selection carries them
+        (dataset, shard, part_id, num_chunks — pinned content, so the cache
+        hits across queries until a flush publishes new chunks); falls back
+        to object identity (holding refs so ids can't be recycled) for
+        ad-hoc series. Bounded FIFO.
+
+        Known tradeoff: the key covers the whole selection, so overlapping
+        selections duplicate tiles and >_TILE_CACHE_MAX distinct selectors
+        thrash; per-partition tiles would compose but conflict with cohort
+        (shared-cadence) packing, which is what makes the kernels fast."""
         from filodb_tpu.query import tilestore as tst
 
-        key = tuple(id(s) for s in series)
+        use_snap = all(s.snapshot_key is not None for s in series)
+        if use_snap:
+            key = tuple(s.snapshot_key for s in series)
+        else:
+            key = tuple(id(s) for s in series)
         entry = self._tile_cache.get(key)
         if entry is None:
-            tiles, idx = tst.build_aligned_tiles(series)
-            has_nan = any(np.isnan(s.values).any() for s in series)
-            entry = (tiles, idx, has_nan, list(series))
+            prefix = [
+                RawSeries(s.labels, s.ts[:self._prefix_len(s)],
+                          s.values[:self._prefix_len(s)], s.is_counter,
+                          s.bucket_les)
+                for s in series
+            ]
+            tiles, idx = tst.build_aligned_tiles(prefix)
+            self.tile_builds += 1
+            entry = (tiles, idx, None if use_snap else list(series))
             if len(self._tile_cache) >= self._TILE_CACHE_MAX:
                 self._tile_cache.pop(next(iter(self._tile_cache)))
             self._tile_cache[key] = entry
         return entry
 
     def _try_aligned(self, series, func: str, steps: np.ndarray,
-                     window_ms: int, offset_ms: int,
+                     step_ms: int, window_ms: int, offset_ms: int,
                      func_args) -> Optional[np.ndarray]:
         """Aligned-tile fast path (tilestore): regular-cadence series are
-        served with shared-column takes only; rows that don't align (or
-        funcs outside the aligned family) return None -> general path.
-        Tiles are cached per series-set identity so repeated queries over
-        the same store snapshot skip pack-time work."""
+        served with shared-column takes over cached device tiles.
+
+        Tiles cover only published (immutable) chunks; steps whose window
+        reaches into any series' write-buffer tail are computed via the
+        general packed path over the live data and spliced onto the device
+        columns — so ingest never invalidates the device store, flushes do
+        (SURVEY §7: 'recent samples answered from a host-side tail scan
+        merged at present stage')."""
         from filodb_tpu.query import tilestore as tst
 
         if func not in tst.ALIGNED_FUNCS:
             return None
-        tiles, idx, has_nan, _ = self._tile_entry(series)
-        if func == "last_sample" and has_nan:
+        if func == "last_sample" and any(
+                np.isnan(s.values).any() for s in series):
             return None     # stale markers must stay visible to the step
+        tiles, idx, _ = self._tile_entry(series)
         if tiles is None or len(idx) != len(series):
             return None     # partial alignment: keep one result path
-        out = tst.evaluate_aligned(tiles, func, steps, window_ms,
+        # windows ending before the earliest tail sample see only tiles
+        tail_min = None
+        for s in series:
+            cl = self._prefix_len(s)
+            if cl < s.ts.size:
+                tm = int(s.ts[cl])
+                tail_min = tm if tail_min is None else min(tail_min, tm)
+        wends = steps - offset_ms
+        t_dev = (steps.size if tail_min is None
+                 else int(np.searchsorted(wends, tail_min, side="left")))
+        if t_dev == 0:
+            return None     # every window touches live data
+        out = tst.evaluate_aligned(tiles, func, steps[:t_dev], window_ms,
                                    offset_ms, func_args)
         res = np.asarray(out)
         if len(idx) != res.shape[0]:
             return None
         # restore original series order (build may drop/reorder rows)
-        full = np.empty((len(series), res.shape[1]), dtype=np.float64)
-        full[np.asarray(idx)] = res
+        full = np.empty((len(series), steps.size), dtype=np.float64)
+        dev = np.empty((len(series), t_dev), dtype=np.float64)
+        dev[np.asarray(idx)] = res
+        full[:, :t_dev] = dev
+        if t_dev < steps.size:
+            full[:, t_dev:] = self._general(series, func, steps[t_dev:],
+                                            step_ms, window_ms, offset_ms,
+                                            func_args)
         return full
 
     @staticmethod
