@@ -77,7 +77,7 @@ class TimeSeriesPartition:
 
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
-                 "ingested", "ooo_dropped", "_decode_cache")
+                 "ingested", "ooo_dropped", "_decode_cache", "_merge_cache")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -94,6 +94,9 @@ class TimeSeriesPartition:
         self.ooo_dropped = 0
         # col_index -> [n_chunks_decoded, ts_parts, val_parts, concat pair]
         self._decode_cache: Dict[int, list] = {}
+        # col_index -> (n_chunks, tail_len, ts, vals): last chunks+tail
+        # merge, reused until either side changes (per-scrape, not per-query)
+        self._merge_cache: Dict[int, Tuple] = {}
 
     # -- write path -------------------------------------------------------
     def ingest(self, timestamp: int, values: Sequence) -> bool:
@@ -218,7 +221,12 @@ class TimeSeriesPartition:
         cts, cvals = self._decoded_chunk_arrays(col_index)
         buf_ts, buf_cols = self.buffer_snapshot()
         if not buf_ts.size:
+            self._merge_cache.pop(col_index, None)
             return cts, cvals, cts.size
+        cached = self._merge_cache.get(col_index)
+        if cached is not None and cached[0] == len(self.chunks) \
+                and cached[1] == buf_ts.size:
+            return cached[2], cached[3], cts.size
         if col.col_type == ColumnType.HISTOGRAM:
             rows = buf_cols[col_index - 1]
             tail = (np.stack(rows).astype(np.float64) if rows
@@ -229,8 +237,13 @@ class TimeSeriesPartition:
                 cvals = np.zeros((0, tail.shape[1]))
         else:
             tail = np.asarray(buf_cols[col_index - 1], dtype=np.float64)
-        return (np.concatenate([cts, buf_ts]),
-                np.concatenate([cvals, tail], axis=0), cts.size)
+        mts = np.concatenate([cts, buf_ts])
+        mvals = np.concatenate([cvals, tail], axis=0)
+        mts.setflags(write=False)
+        mvals.setflags(write=False)
+        self._merge_cache[col_index] = (len(self.chunks), buf_ts.size,
+                                        mts, mvals)
+        return mts, mvals, cts.size
 
     def read_range(self, start_ts: int, end_ts: int, col_index: int
                    ) -> Tuple[np.ndarray, np.ndarray]:

@@ -27,7 +27,8 @@ from filodb_tpu.http import prom_json
 from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
                                       parse_query_range, selector_to_filters)
 from filodb_tpu.query import logical as lp
-from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.engine import QueryEngine  # noqa: F401 (re-export)
+from filodb_tpu.query.planner import QueryPlanner
 from filodb_tpu.query.model import GridResult, QueryError, ScalarResult
 
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
@@ -39,10 +40,14 @@ class FiloHttpServer:
     def __init__(self, shards_by_dataset: Dict[str, list],
                  backend: Optional[object] = None,
                  shard_mapper: Optional[object] = None,
+                 mesh_executor: Optional[object] = None,
+                 spread: int = 0,
                  host: str = "127.0.0.1", port: int = 0):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
+        self.mesh_executor = mesh_executor
+        self.spread = spread
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,7 +111,10 @@ class FiloHttpServer:
         shards = self.shards_by_dataset.get(ds)
         if shards is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
-        engine = QueryEngine(shards, backend=self.backend)
+        engine = QueryPlanner(shards, backend=self.backend,
+                              shard_mapper=self.shard_mapper,
+                              mesh_executor=self.mesh_executor,
+                              spread=self.spread)
         if rest == "query_range":
             return self._query_range(engine, qs)
         if rest == "query":

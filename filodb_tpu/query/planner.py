@@ -1,0 +1,300 @@
+"""Query planner: materializes LogicalPlans into executable plans with
+shard pruning and distributed (mesh) lowering.
+
+TPU-native counterpart of the reference planner stack
+(coordinator/queryplanner/SingleClusterPlanner.scala:253 materialize,
+:430 walkLogicalPlanTree, :872 shardsFromFilters + dispatcherForShard :138;
+DefaultPlanner's aggregate lowering). Differences by design:
+
+- Shard pruning is identical in spirit: equality filters on the shard-key
+  columns (_ws_, _ns_, metric) hash to a shard subset via the bit-compatible
+  `query_shards` (RecordBuilder.scala:667 shardKeyHash + spread bit split);
+  anything else fans out to all queryable shards.
+
+- Instead of serializing an ExecPlan tree to per-shard actors
+  (ActorPlanDispatcher + Kryo), the scatter-gather IS a device-mesh program:
+  the `agg(rangefunc(selector[w])) by (...)` shape lowers onto
+  `MeshExecutor.window_aggregate` — per-shard leaf evaluation rides the mesh
+  'shard' axis, the reduce is a psum-tree collective over ICI
+  (ReduceAggregateExec ≡ the collective), and only the tiny [groups, steps]
+  grid returns to the host.
+
+- Every other plan shape falls back to `LocalEngineExec`: the single-process
+  engine over the pruned shard subset (InProcessPlanDispatcher equivalent).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.core.record import shard_key_hash
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.engine import (METRIC_LABELS, QueryEngine,
+                                     select_raw_series)
+from filodb_tpu.query.model import (GridResult, QueryError, QueryStats,
+                                    RangeParams)
+
+# aggregations executable as mesh collectives (parallel/mesh.py MESH_AGGS)
+_MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
+
+
+def walk_leaf_filters(plan) -> List[Tuple[ColumnFilter, ...]]:
+    """Collect the filter sets of every RawSeries leaf under a plan
+    (walkLogicalPlanTree's shard resolution inputs)."""
+    out: List[Tuple[ColumnFilter, ...]] = []
+
+    def rec(p):
+        if p is None or isinstance(p, (int, float, str)):
+            return
+        if isinstance(p, lp.RawSeriesPlan):
+            out.append(tuple(p.filters))
+            return
+        for f in getattr(p, "__dataclass_fields__", {}):
+            v = getattr(p, f)
+            if isinstance(v, tuple):
+                for item in v:
+                    rec(item)
+            else:
+                rec(v)
+
+    rec(plan)
+    return out
+
+
+@dataclass
+class PlannerParams:
+    """(core/query/QueryContext PlannerParams equivalent)."""
+    spread: int = 0
+    sample_limit: int = 0       # 0 = unlimited (guardrails layer)
+    series_limit: int = 0
+
+
+class ExecPlan:
+    """Materialized plan node (query/exec/ExecPlan.scala:46)."""
+
+    def execute(self):
+        raise NotImplementedError
+
+    def plan_tree(self, indent: int = 0) -> str:
+        return " " * indent + type(self).__name__
+
+
+@dataclass
+class LocalEngineExec(ExecPlan):
+    """Evaluate a LogicalPlan on the single-process engine over a pruned
+    shard subset (InProcessPlanDispatcher.scala:25 semantics)."""
+    plan: object
+    shards: Sequence[object]
+    backend: Optional[object]
+    stats: QueryStats
+
+    def execute(self):
+        eng = QueryEngine(self.shards, backend=self.backend)
+        out = eng.execute(self.plan)
+        self.stats.add(eng.stats)
+        return out
+
+    def plan_tree(self, indent: int = 0) -> str:
+        pads = " " * indent
+        shard_nums = [getattr(s, "shard_num", "?") for s in self.shards]
+        return (f"{pads}LocalEngineExec(shards={shard_nums}, "
+                f"plan={type(self.plan).__name__})")
+
+
+@dataclass
+class MeshAggregateExec(ExecPlan):
+    """agg(rangefunc(selector[w])) by (labels) on the device mesh.
+
+    Fuses SelectRawPartitions + PeriodicSamplesMapper + AggregateMapReduce +
+    ReduceAggregateExec into one pjit'd program with collectives
+    (parallel/mesh.py MeshExecutor.window_aggregate)."""
+    agg_op: str
+    by: Tuple[str, ...]
+    function: str
+    window_ms: int
+    func_args: Tuple[float, ...]
+    offset_ms: int
+    params: RangeParams
+    raw: lp.RawSeriesPlan
+    shards: Sequence[object]
+    mesh_executor: object
+    stats: QueryStats
+
+    def execute(self) -> GridResult:
+        from filodb_tpu.query.engine import clip_series
+
+        n_mesh = self.mesh_executor.mesh.shape["shard"]
+        series_by_shard: List[List] = []
+        for shard in self.shards:
+            row = select_raw_series(
+                [shard], self.raw.filters, self.raw.start_ms,
+                self.raw.end_ms, self.raw.column, self.stats, full=True)
+            # pack/ship only the query span, not the whole retention
+            series_by_shard.append(
+                clip_series(row, self.raw.start_ms, self.raw.end_ms))
+        # histograms are not mesh-lowerable; caller pre-checked 1-D only
+        # pad the shard list to a multiple of the mesh shard axis
+        while len(series_by_shard) % n_mesh:
+            series_by_shard.append([])
+        # global group table: by-labels value tuple -> group id
+        group_keys: Dict[Tuple, int] = {}
+        gids_by_shard: List[List[int]] = []
+        for row in series_by_shard:
+            gids = []
+            for s in row:
+                key = tuple((l, s.labels.get(l, "")) for l in self.by)
+                gid = group_keys.setdefault(key, len(group_keys))
+                gids.append(gid)
+            gids_by_shard.append(gids)
+        steps = self.params.steps
+        if not group_keys:
+            return GridResult(steps, [],
+                              np.zeros((0, steps.size), dtype=np.float64))
+        out = self.mesh_executor.window_aggregate(
+            series_by_shard, self.params, self.function, self.window_ms,
+            self.agg_op, gids_by_shard, len(group_keys),
+            func_args=self.func_args, offset_ms=self.offset_ms)
+        keys = [dict(k) for k in group_keys]
+        return GridResult(steps, keys, np.asarray(out))
+
+    def plan_tree(self, indent: int = 0) -> str:
+        pads = " " * indent
+        shard_nums = [getattr(s, "shard_num", "?") for s in self.shards]
+        return (f"{pads}MeshAggregateExec(agg={self.agg_op}, by={self.by},\n"
+                f"{pads}  func={self.function}, shards={shard_nums})")
+
+
+class QueryPlanner:
+    """materialize(LogicalPlan) -> ExecPlan (QueryPlanner.scala:17;
+    SingleClusterPlanner.scala:52). Also the execution facade the HTTP
+    layer calls (`execute` = materialize + run)."""
+
+    def __init__(self, shards: Sequence[object],
+                 backend: Optional[object] = None,
+                 shard_mapper: Optional[object] = None,
+                 mesh_executor: Optional[object] = None,
+                 spread: int = 0,
+                 shard_key_columns: Tuple[str, ...] = ("_ws_", "_ns_"),
+                 metric_column: str = "_metric_"):
+        self.shards = list(shards)
+        self._by_num = {getattr(s, "shard_num", i): s
+                        for i, s in enumerate(self.shards)}
+        self.backend = backend
+        self.mapper = shard_mapper
+        self.mesh = mesh_executor
+        self.spread = spread
+        self.shard_key_columns = tuple(shard_key_columns)
+        self.metric_column = metric_column
+        self.stats = QueryStats()
+
+    # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
+    def shards_from_filters(self, filters: Sequence[ColumnFilter]
+                            ) -> Optional[List[int]]:
+        """Shard subset for one leaf, or None when filters can't resolve a
+        shard key (fan out to all)."""
+        if self.mapper is None:
+            return None
+        eqs = {f.label: f.value for f in filters if f.op == "eq"}
+        metric = None
+        for ml in (self.metric_column,) + METRIC_LABELS:
+            if ml in eqs:
+                metric = eqs[ml]
+                break
+        if metric is None:
+            return None
+        values = []
+        for c in self.shard_key_columns:
+            if c == self.metric_column:
+                continue
+            if c not in eqs:
+                return None
+            values.append(eqs[c])
+        skh = shard_key_hash(values, metric)
+        return self.mapper.query_shards(skh, self.spread)
+
+    def _resolve_shards(self, plan) -> List[object]:
+        """Union of pruned shard subsets across all leaves; all shards when
+        any leaf can't be pruned."""
+        leaves = walk_leaf_filters(plan)
+        if not leaves:
+            return self._queryable(None)
+        nums: set = set()
+        for filters in leaves:
+            subset = self.shards_from_filters(filters)
+            if subset is None:
+                return self._queryable(None)
+            nums.update(subset)
+        return self._queryable(sorted(nums))
+
+    def _queryable(self, nums: Optional[List[int]]) -> List[object]:
+        if nums is None:
+            nums = sorted(self._by_num)
+        if self.mapper is not None:
+            ok = set(self.mapper.active_shards(nums))
+            nums = [n for n in nums if n in ok]
+        return [self._by_num[n] for n in nums if n in self._by_num]
+
+    # -- materialization -------------------------------------------------
+    def materialize(self, plan) -> ExecPlan:
+        """(SingleClusterPlanner.scala:253). Pattern-matches the mesh-
+        lowerable aggregate shape; everything else runs locally over the
+        pruned shard subset."""
+        mesh_plan = self._try_mesh_lowering(plan)
+        if mesh_plan is not None:
+            return mesh_plan
+        return LocalEngineExec(plan, self._resolve_shards(plan),
+                               self.backend, self.stats)
+
+    def execute(self, plan):
+        return self.materialize(plan).execute()
+
+    def _try_mesh_lowering(self, plan) -> Optional[MeshAggregateExec]:
+        from filodb_tpu.query.tpu import DEVICE_FUNCS
+
+        if self.mesh is None:
+            return None
+        if not isinstance(plan, lp.Aggregate) or plan.op not in _MESH_AGGS:
+            return None
+        if plan.without or plan.params:
+            return None
+        inner = plan.inner
+        if not isinstance(inner, lp.PeriodicSeriesWithWindowing):
+            return None
+        if inner.at_ms is not None:
+            return None
+        if inner.function not in DEVICE_FUNCS:
+            return None
+        raw = inner.raw
+        if not isinstance(raw, lp.RawSeriesPlan):
+            return None
+        shards = self._resolve_shards(plan)
+        if not shards:
+            return None
+        # histogram columns can't ride the [S,N] mesh tiles (yet)
+        if self._selects_histograms(shards, raw):
+            return None
+        return MeshAggregateExec(
+            agg_op=plan.op, by=tuple(plan.by), function=inner.function,
+            window_ms=inner.window_ms, func_args=tuple(inner.func_args),
+            offset_ms=inner.offset_ms,
+            params=RangeParams(inner.start_ms, inner.step_ms, inner.end_ms),
+            raw=raw, shards=shards, mesh_executor=self.mesh,
+            stats=self.stats)
+
+    @staticmethod
+    def _selects_histograms(shards, raw: lp.RawSeriesPlan) -> bool:
+        from filodb_tpu.core.schemas import ColumnType
+        for shard in shards:
+            for part in shard.lookup_partitions(raw.filters, raw.start_ms,
+                                                raw.end_ms):
+                name = raw.column or part.schema.value_column
+                for c in part.schema.columns:
+                    if c.name == name:
+                        if c.col_type == ColumnType.HISTOGRAM:
+                            return True
+                        break
+        return False

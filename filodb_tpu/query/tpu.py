@@ -483,7 +483,9 @@ class TpuBackend:
             ]
             tiles, idx = tst.build_aligned_tiles(prefix)
             self.tile_builds += 1
-            entry = (tiles, idx, None if use_snap else list(series))
+            prefix_has_nan = any(np.isnan(p.values).any() for p in prefix)
+            entry = (tiles, idx, prefix_has_nan,
+                     None if use_snap else list(series))
             if len(self._tile_cache) >= self._TILE_CACHE_MAX:
                 self._tile_cache.pop(next(iter(self._tile_cache)))
             self._tile_cache[key] = entry
@@ -505,10 +507,14 @@ class TpuBackend:
 
         if func not in tst.ALIGNED_FUNCS:
             return None
-        if func == "last_sample" and any(
-                np.isnan(s.values).any() for s in series):
-            return None     # stale markers must stay visible to the step
-        tiles, idx, _ = self._tile_entry(series)
+        tiles, idx, prefix_has_nan, _ = self._tile_entry(series)
+        if func == "last_sample":
+            # stale markers must stay visible to the step; the immutable
+            # prefix's flag is cached with the tiles, only tails re-scan
+            if prefix_has_nan or any(
+                    np.isnan(s.values[self._prefix_len(s):]).any()
+                    for s in series):
+                return None
         if tiles is None or len(idx) != len(series):
             return None     # partial alignment: keep one result path
         # windows ending before the earliest tail sample see only tiles

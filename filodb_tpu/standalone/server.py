@@ -30,6 +30,11 @@ DEFAULTS = {
     "max-chunks-size": 400,
     "port": 8080,
     "node-id": "node0",
+    # spread used for shard-key routing (filodb-defaults.conf:319
+    # default-spread); must match the ingest-side spread
+    "default-spread": 1,
+    # lower agg(rangefunc(...)) onto the device mesh when >1 jax device
+    "mesh-enabled": False,
 }
 
 
@@ -58,9 +63,21 @@ class FiloServer:
                 self.backend = TpuBackend()
             except Exception:            # device unavailable -> oracle
                 self.backend = None
+        mesh_ex = None
+        if self.config.get("mesh-enabled"):
+            try:
+                import jax
+
+                from filodb_tpu.parallel.mesh import MeshExecutor, make_mesh
+                if len(jax.devices()) > 1:
+                    mesh_ex = MeshExecutor(make_mesh())
+            except Exception:
+                mesh_ex = None
         self.http = FiloHttpServer(
             {self.ref.dataset: self.store.shards(self.ref)},
             backend=self.backend, shard_mapper=self.mapper,
+            mesh_executor=mesh_ex,
+            spread=int(self.config.get("default-spread", 1)),
             port=self.config["port"])
         self.http.start()
         return self
