@@ -28,6 +28,7 @@ Key departures from the JVM design, chosen for the TPU execution model:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
@@ -77,7 +78,8 @@ class TimeSeriesPartition:
 
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
-                 "ingested", "ooo_dropped", "_decode_cache", "_merge_cache")
+                 "ingested", "ooo_dropped", "_decode_cache", "_merge_cache",
+                 "persisted_chunks", "odp_pending")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -97,6 +99,8 @@ class TimeSeriesPartition:
         # col_index -> (n_chunks, tail_len, ts, vals): last chunks+tail
         # merge, reused until either side changes (per-scrape, not per-query)
         self._merge_cache: Dict[int, Tuple] = {}
+        self.persisted_chunks = 0   # prefix of `chunks` already in the store
+        self.odp_pending = False    # True: chunks live in the ColumnStore
 
     # -- write path -------------------------------------------------------
     def ingest(self, timestamp: int, values: Sequence) -> bool:
@@ -275,6 +279,9 @@ class ShardStats:
     encoded_bytes: int = 0
     flushes_done: int = 0
     partitions_evicted: int = 0
+    chunks_persisted: int = 0
+    partitions_paged_in: int = 0    # ODP page-ins (ChunkSourceStats)
+    partitions_bootstrapped: int = 0
 
 
 class TimeSeriesShard:
@@ -284,13 +291,15 @@ class TimeSeriesShard:
     def __init__(self, ref: DatasetRef, schemas: Schemas, shard_num: int,
                  num_groups: int = 8,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
-                 max_series: int = 1_000_000):
+                 max_series: int = 1_000_000,
+                 column_store: Optional[object] = None):
         self.ref = ref
         self.schemas = schemas
         self.shard_num = shard_num
         self.num_groups = num_groups
         self.max_chunk_rows = max_chunk_rows
         self.max_series = max_series  # cardinality quota (ratelimit/)
+        self.column_store = column_store  # ChunkSink/RawChunkSource boundary
         self.partitions: Dict[int, TimeSeriesPartition] = {}
         self._by_part_key: Dict[bytes, int] = {}
         self._next_part_id = 0
@@ -298,6 +307,10 @@ class TimeSeriesShard:
         self.stats = ShardStats()
         # per-group ingestion checkpoint offsets (CheckpointTable semantics)
         self.checkpoints: Dict[int, int] = {}
+        # serializes ODP page-ins (queries arrive from concurrent HTTP
+        # threads; page-in rebinds part.chunks — everything else on the
+        # read path sees immutable snapshots and needs no lock)
+        self._odp_lock = threading.Lock()
 
     # -- ingest path ------------------------------------------------------
     def get_or_create_partition(self, part_key: PartKey, first_ts: int
@@ -329,6 +342,15 @@ class TimeSeriesShard:
             if part is None:
                 self.stats.rows_skipped += 1
                 continue
+            if part.odp_pending:
+                # only page in when the row could overlap persisted history
+                # (replay — the OOO guard then sees it); normal continuation
+                # needs just the index end time, so restart recovery does
+                # not trigger a full-retention read storm
+                endt = self.index.end_time(part.part_id)
+                if endt is not None and endt != END_TIME_INGESTING \
+                        and row.timestamp <= endt:
+                    self._ensure_loaded(part)
             if part.ingest(row.timestamp, row.values):
                 n += 1
                 self.index.update_end_time(part.part_id, row.timestamp)
@@ -344,9 +366,12 @@ class TimeSeriesShard:
         return part_id % self.num_groups
 
     def flush_group(self, group: int, offset: int = -1) -> int:
-        """Encode write buffers of one flush group
-        (TimeSeriesShard.scala:1341 doFlushSteps).  Returns chunks written."""
+        """Encode write buffers of one flush group, persist new chunks +
+        partkeys + the group checkpoint (TimeSeriesShard.scala:1341
+        doFlushSteps: encode → ColumnStore.write → index/partkey write →
+        writeCheckpoint).  Returns chunks written."""
         n = 0
+        touched: List[TimeSeriesPartition] = []
         for pid, part in self.partitions.items():
             if pid % self.num_groups != group:
                 continue
@@ -355,9 +380,32 @@ class TimeSeriesShard:
                 n += 1
                 self.stats.chunks_encoded += 1
                 self.stats.encoded_bytes += sum(len(v) for v in info.vectors)
+            if self.column_store is not None \
+                    and part.num_chunks > part.persisted_chunks:
+                touched.append(part)
+        if touched:
+            from filodb_tpu.store import PartKeyEntry
+            entries = []
+            for part in touched:
+                new = part.chunks[part.persisted_chunks:]
+                self.column_store.write_chunks(
+                    self.ref.dataset, self.shard_num,
+                    part.part_key.to_bytes(), new)
+                part.persisted_chunks = part.num_chunks
+                self.stats.chunks_persisted += len(new)
+                entries.append(PartKeyEntry(
+                    part.part_key.to_bytes(),
+                    self.index.start_time(part.part_id)
+                    or part.earliest_timestamp or 0,
+                    part.last_timestamp or 0))
+            self.column_store.write_part_keys(self.ref.dataset,
+                                              self.shard_num, entries)
         self.stats.flushes_done += 1
         if offset >= 0:
             self.checkpoints[group] = offset
+            if self.column_store is not None:
+                self.column_store.write_checkpoint(
+                    self.ref.dataset, self.shard_num, group, offset)
         return n
 
     def flush_all(self, offset: int = -1) -> int:
@@ -370,29 +418,114 @@ class TimeSeriesShard:
             return -1
         return min(self.checkpoints.values())
 
+    # -- persistence / recovery -------------------------------------------
+    def bootstrap_from_store(self) -> int:
+        """Rebuild the tag index + partition shells from persisted partkeys
+        and load checkpoint offsets (IndexBootstrapper.scala:43; recovery
+        watermark read IngestionActor.scala:174). Chunk data stays in the
+        store until a query or ingest pages it in (ODP)."""
+        if self.column_store is None:
+            return 0
+        n = 0
+        for e in self.column_store.scan_part_keys(self.ref.dataset,
+                                                  self.shard_num):
+            pk = PartKey.from_bytes(e.part_key)
+            part = self.get_or_create_partition(pk, e.start_ts)
+            if part is None:
+                continue
+            part.odp_pending = True
+            self.index.update_end_time(part.part_id, e.end_ts)
+            n += 1
+        self.checkpoints = dict(self.column_store.read_checkpoints(
+            self.ref.dataset, self.shard_num))
+        self.stats.partitions_bootstrapped += n
+        return n
+
+    def _ensure_loaded(self, part: TimeSeriesPartition) -> None:
+        """ODP read-through: page this partition's chunks back from the
+        ColumnStore (OnDemandPagingShard.scala:26 /
+        DemandPagedChunkStore.scala:34 — granularity here is the whole
+        partition; chunks are append-only so the merge is a sorted concat)."""
+        with self._odp_lock:
+            if not part.odp_pending or self.column_store is None:
+                part.odp_pending = False
+                return
+            loaded = self.column_store.read_chunks(
+                self.ref.dataset, self.shard_num, part.part_key.to_bytes())
+            # skip chunks already in memory (a shell that ingested + flushed
+            # before page-in has persisted chunks present on both sides)
+            have = {c.id for c in part.chunks}
+            infos = [ChunkSetInfo(c.chunk_id, c.num_rows, c.start_ts,
+                                  c.end_ts, c.vectors)
+                     for c in loaded if c.chunk_id not in have]
+            part.chunks = infos + part.chunks
+            part.persisted_chunks += len(infos)
+            part._chunk_seq = max(part._chunk_seq, len(part.chunks))
+            part._decode_cache.clear()
+            part._merge_cache.clear()
+            part.odp_pending = False
+            self.stats.partitions_paged_in += 1
+
     # -- read path --------------------------------------------------------
     def lookup_partitions(self, filters: Sequence[ColumnFilter],
                           start_ts: int, end_ts: int
                           ) -> List[TimeSeriesPartition]:
-        """(memstore lookupPartitions via the tag index)."""
+        """(memstore lookupPartitions via the tag index; pages in evicted
+        partitions read-through like OnDemandPagingShard)."""
         pids = self.index.part_ids_from_filters(filters, start_ts, end_ts)
-        return [self.partitions[p] for p in pids]
+        out = []
+        for p in pids:
+            part = self.partitions[p]
+            if part.odp_pending:
+                self._ensure_loaded(part)
+            out.append(part)
+        return out
 
     # -- eviction ---------------------------------------------------------
     def evict_partitions(self, cutoff_ts: int) -> int:
         """Evict series whose data ended before cutoff
-        (PartitionEvictionPolicy / EvictablePartIdQueueSet equivalents)."""
+        (PartitionEvictionPolicy / EvictablePartIdQueueSet equivalents).
+
+        With a ColumnStore the partition becomes an ODP shell: unpersisted
+        chunks are written out first, memory is released, the index entry
+        stays so queries can page the data back. Without one, the series is
+        dropped entirely (memory-only deployments)."""
         evict = [
             pid for pid, p in self.partitions.items()
             if (p.last_timestamp is not None and p.last_timestamp < cutoff_ts
-                and not p._ts_buf)
+                and not p._ts_buf and not p.odp_pending)
         ]
-        for pid in evict:
-            part = self.partitions.pop(pid)
-            self._by_part_key.pop(part.part_key.to_bytes(), None)
-        self.index.remove_part_keys(evict)
+        if self.column_store is not None:
+            from filodb_tpu.store import PartKeyEntry
+            entries = []
+            for pid in evict:
+                part = self.partitions[pid]
+                new = part.chunks[part.persisted_chunks:]
+                if new:
+                    self.column_store.write_chunks(
+                        self.ref.dataset, self.shard_num,
+                        part.part_key.to_bytes(), new)
+                    self.stats.chunks_persisted += len(new)
+                entries.append(PartKeyEntry(
+                    part.part_key.to_bytes(),
+                    self.index.start_time(pid)
+                    or part.earliest_timestamp or 0,
+                    part.last_timestamp or 0))
+                part.chunks = []
+                part.persisted_chunks = 0
+                part._decode_cache.clear()
+                part._merge_cache.clear()
+                part.odp_pending = True
+            if entries:
+                self.column_store.write_part_keys(
+                    self.ref.dataset, self.shard_num, entries)
+        else:
+            for pid in evict:
+                part = self.partitions.pop(pid)
+                self._by_part_key.pop(part.part_key.to_bytes(), None)
+            self.index.remove_part_keys(evict)
+            self.stats.num_series = len(self.partitions)
         self.stats.partitions_evicted += len(evict)
-        self.stats.num_series = len(self.partitions)
         return len(evict)
 
 
@@ -400,19 +533,28 @@ class TimeSeriesMemStore:
     """Top-level store: dataset -> shards (memstore/TimeSeriesMemStore.scala:26).
     """
 
-    def __init__(self, schemas: Optional[Schemas] = None):
+    def __init__(self, schemas: Optional[Schemas] = None,
+                 column_store: Optional[object] = None):
         from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
         self.schemas = schemas or DEFAULT_SCHEMAS
+        self.column_store = column_store
         self._shards: Dict[DatasetRef, Dict[int, TimeSeriesShard]] = {}
 
     def setup(self, ref: DatasetRef, shard_num: int, num_groups: int = 8,
-              max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS) -> TimeSeriesShard:
+              max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
+              bootstrap: bool = False) -> TimeSeriesShard:
+        """Create one shard; with ``bootstrap`` (and a column store) the tag
+        index + checkpoints are recovered from persistence
+        (TimeSeriesMemStore.scala setup + IndexBootstrapper on startup)."""
         shards = self._shards.setdefault(ref, {})
         if shard_num in shards:
             raise ValueError(f"shard {shard_num} already set up for {ref}")
         shard = TimeSeriesShard(ref, self.schemas, shard_num, num_groups,
-                                max_chunk_rows)
+                                max_chunk_rows,
+                                column_store=self.column_store)
         shards[shard_num] = shard
+        if bootstrap:
+            shard.bootstrap_from_store()
         return shard
 
     def get_shard(self, ref: DatasetRef, shard_num: int) -> TimeSeriesShard:

@@ -41,7 +41,7 @@ class FiloHttpServer:
                  backend: Optional[object] = None,
                  shard_mapper: Optional[object] = None,
                  mesh_executor: Optional[object] = None,
-                 spread: int = 0,
+                 spread: int = 1,   # MUST match ingest spread (default-spread)
                  host: str = "127.0.0.1", port: int = 0):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend

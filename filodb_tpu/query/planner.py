@@ -177,7 +177,7 @@ class QueryPlanner:
                  backend: Optional[object] = None,
                  shard_mapper: Optional[object] = None,
                  mesh_executor: Optional[object] = None,
-                 spread: int = 0,
+                 spread: int = 1,   # system default-spread; must match ingest
                  shard_key_columns: Tuple[str, ...] = ("_ws_", "_ns_"),
                  metric_column: str = "_metric_"):
         self.shards = list(shards)

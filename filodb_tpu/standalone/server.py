@@ -35,6 +35,9 @@ DEFAULTS = {
     "default-spread": 1,
     # lower agg(rangefunc(...)) onto the device mesh when >1 jax device
     "mesh-enabled": False,
+    # chunk/partkey/checkpoint persistence root; None = memory-only
+    # (conf/timeseries-filodb-server.conf store path equivalent)
+    "data-dir": None,
 }
 
 
@@ -43,7 +46,12 @@ class FiloServer:
                  backend: Optional[object] = None):
         self.config = {**DEFAULTS, **(config or {})}
         self.ref = DatasetRef(self.config["dataset"])
-        self.store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+        column_store = None
+        if self.config.get("data-dir"):
+            from filodb_tpu.store import FlatFileColumnStore
+            column_store = FlatFileColumnStore(self.config["data-dir"])
+        self.store = TimeSeriesMemStore(DEFAULT_SCHEMAS,
+                                        column_store=column_store)
         self.mapper = ShardMapper(self.config["num-shards"])
         self.backend = backend
         self.http: Optional[FiloHttpServer] = None
@@ -53,7 +61,8 @@ class FiloServer:
         for shard in range(n):
             self.store.setup(self.ref, shard,
                              num_groups=self.config["groups-per-shard"],
-                             max_chunk_rows=self.config["max-chunks-size"])
+                             max_chunk_rows=self.config["max-chunks-size"],
+                             bootstrap=self.store.column_store is not None)
         assign_shards_evenly(self.mapper, [self.config["node-id"]])
         for shard in range(n):
             self.mapper.activate(shard)

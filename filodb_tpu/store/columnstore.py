@@ -1,0 +1,288 @@
+"""ColumnStore: durable chunk + partkey + checkpoint persistence.
+
+The pluggable boundary mirrors the reference's ChunkSink/RawChunkSource
+(store/ChunkSink.scala; store/ChunkSource.scala:25) and the Cassandra
+implementation's tables (cassandra/columnstore/CassandraColumnStore.scala:54:
+TimeSeriesChunksTable, PartitionKeysTable; metastore CheckpointTable.scala:26)
+— but the storage engine is TPU-host-native: encoded chunks are already
+immutable compressed byte vectors (the interchange format), so persistence is
+append-only framed logs per shard, fsync'd per flush group. No external
+database is required; an object-store or Cassandra client can implement the
+same four-method API.
+
+Layout under root:
+    <dataset>/shard=<n>/chunks.log      framed: partkey + chunk meta + vectors
+    <dataset>/shard=<n>/partkeys.log    framed: partkey + startTime + endTime
+    <dataset>/shard=<n>/checkpoints.json   {group: offset} (atomic replace)
+
+Log framing is little-endian struct records with a magic + length prefix so
+readers can skip torn tails after a crash (the reference gets atomicity from
+Cassandra; here a torn final record is simply ignored — the checkpoint
+watermark re-ingests anything after it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+_CHUNK_MAGIC = 0xC4A2
+_PK_MAGIC = 0xBE11
+
+# chunk record header: magic u16, pk_len u16, ncols u16, pad u16,
+#                      chunk_id i64, num_rows i32, start i64, end i64
+_CHUNK_HDR = struct.Struct("<HHHHqiqq")
+# partkey record: magic u16, pk_len u16, start i64, end i64
+_PK_HDR = struct.Struct("<HHqq")
+
+
+@dataclass(frozen=True)
+class PartKeyEntry:
+    """One persisted partkey (PartitionKeysTable row)."""
+    part_key: bytes
+    start_ts: int
+    end_ts: int
+
+
+@dataclass(frozen=True)
+class PersistedChunk:
+    """One persisted chunk set (TimeSeriesChunksTable row)."""
+    part_key: bytes
+    chunk_id: int
+    num_rows: int
+    start_ts: int
+    end_ts: int
+    vectors: Tuple[bytes, ...]
+
+
+class ColumnStore:
+    """Abstract persistence API (ChunkSink + RawChunkSource + checkpoints)."""
+
+    def write_chunks(self, dataset: str, shard: int, part_key: bytes,
+                     chunks: Sequence) -> None:
+        raise NotImplementedError
+
+    def read_chunks(self, dataset: str, shard: int, part_key: bytes,
+                    start_ts: int = 0, end_ts: int = 1 << 62
+                    ) -> List[PersistedChunk]:
+        raise NotImplementedError
+
+    def write_part_keys(self, dataset: str, shard: int,
+                        entries: Sequence[PartKeyEntry]) -> None:
+        raise NotImplementedError
+
+    def scan_part_keys(self, dataset: str, shard: int
+                       ) -> Iterator[PartKeyEntry]:
+        raise NotImplementedError
+
+    def write_checkpoint(self, dataset: str, shard: int, group: int,
+                         offset: int) -> None:
+        raise NotImplementedError
+
+    def read_checkpoints(self, dataset: str, shard: int) -> Dict[int, int]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NullColumnStore(ColumnStore):
+    """No-op sink (store/ChunkSink.scala:126 NullColumnStore): memstore-only
+    deployments and tests."""
+
+    def write_chunks(self, dataset, shard, part_key, chunks) -> None:
+        pass
+
+    def read_chunks(self, dataset, shard, part_key, start_ts=0,
+                    end_ts=1 << 62):
+        return []
+
+    def write_part_keys(self, dataset, shard, entries) -> None:
+        pass
+
+    def scan_part_keys(self, dataset, shard):
+        return iter(())
+
+    def write_checkpoint(self, dataset, shard, group, offset) -> None:
+        pass
+
+    def read_checkpoints(self, dataset, shard):
+        return {}
+
+
+class FlatFileColumnStore(ColumnStore):
+    """Append-only framed-log store. One writer per shard (the ingest
+    thread), readers tolerate torn tails."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # (dataset, shard) -> {part_key: [file offsets]} lazy ODP index
+        self._chunk_index: Dict[Tuple[str, int], Dict[bytes, List[int]]] = {}
+
+    # -- paths ------------------------------------------------------------
+    def _shard_dir(self, dataset: str, shard: int) -> str:
+        d = os.path.join(self.root, dataset, f"shard={shard}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _chunks_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard), "chunks.log")
+
+    def _pk_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard), "partkeys.log")
+
+    def _ckpt_path(self, dataset: str, shard: int) -> str:
+        return os.path.join(self._shard_dir(dataset, shard),
+                            "checkpoints.json")
+
+    # -- chunks (TimeSeriesChunksTable) ------------------------------------
+    def write_chunks(self, dataset, shard, part_key, chunks) -> None:
+        if not chunks:
+            return
+        path = self._chunks_path(dataset, shard)
+        idx = self._chunk_index.get((dataset, shard))
+        with open(path, "ab") as f:
+            for c in chunks:
+                off = f.tell()
+                vec_lens = struct.pack(f"<{len(c.vectors)}i",
+                                       *[len(v) for v in c.vectors])
+                f.write(_CHUNK_HDR.pack(_CHUNK_MAGIC, len(part_key),
+                                        len(c.vectors), 0, c.id, c.num_rows,
+                                        c.start_ts, c.end_ts))
+                f.write(part_key)
+                f.write(vec_lens)
+                for v in c.vectors:
+                    f.write(v)
+                if idx is not None:
+                    idx.setdefault(part_key, []).append(off)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _iter_chunks(self, dataset, shard, offsets: Sequence[int]
+                     ) -> Iterator[PersistedChunk]:
+        """Read chunk records at known offsets (from _ensure_chunk_index,
+        which validated framing)."""
+        path = self._chunks_path(dataset, shard)
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            for off in offsets:
+                f.seek(off)
+                hdr = f.read(_CHUNK_HDR.size)
+                if len(hdr) < _CHUNK_HDR.size:
+                    return
+                magic, pk_len, ncols, _, cid, nrows, st, en = \
+                    _CHUNK_HDR.unpack(hdr)
+                if magic != _CHUNK_MAGIC:
+                    return                       # torn/corrupt tail
+                pk = f.read(pk_len)
+                lens_buf = f.read(4 * ncols)
+                if len(pk) < pk_len or len(lens_buf) < 4 * ncols:
+                    return
+                vec_lens = struct.unpack(f"<{ncols}i", lens_buf)
+                vecs = []
+                for vl in vec_lens:
+                    b = f.read(vl)
+                    if len(b) < vl:
+                        return
+                    vecs.append(b)
+                yield PersistedChunk(pk, cid, nrows, st, en, tuple(vecs))
+
+    def _ensure_chunk_index(self, dataset, shard) -> Dict[bytes, List[int]]:
+        key = (dataset, shard)
+        idx = self._chunk_index.get(key)
+        if idx is not None:
+            return idx
+        idx = {}
+        path = self._chunks_path(dataset, shard)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                while True:
+                    off = f.tell()
+                    hdr = f.read(_CHUNK_HDR.size)
+                    if len(hdr) < _CHUNK_HDR.size:
+                        break
+                    magic, pk_len, ncols, _, *_rest = _CHUNK_HDR.unpack(hdr)
+                    if magic != _CHUNK_MAGIC:
+                        break
+                    pk = f.read(pk_len)
+                    lens_buf = f.read(4 * ncols)
+                    if len(pk) < pk_len or len(lens_buf) < 4 * ncols:
+                        break
+                    skip = sum(struct.unpack(f"<{ncols}i", lens_buf))
+                    cur = f.tell()
+                    if cur + skip > os.fstat(f.fileno()).st_size:
+                        break
+                    idx.setdefault(pk, []).append(off)
+                    f.seek(skip, os.SEEK_CUR)
+        self._chunk_index[key] = idx
+        return idx
+
+    def read_chunks(self, dataset, shard, part_key, start_ts=0,
+                    end_ts=1 << 62) -> List[PersistedChunk]:
+        """ODP read path (readRawPartitions, CassandraColumnStore.scala:699).
+        First call per shard builds an in-memory offset index (one scan)."""
+        idx = self._ensure_chunk_index(dataset, shard)
+        offs = idx.get(part_key, [])
+        out = [c for c in self._iter_chunks(dataset, shard, offs)
+               if c.end_ts >= start_ts and c.start_ts <= end_ts]
+        out.sort(key=lambda c: c.start_ts)
+        return out
+
+    # -- partkeys (PartitionKeysTable) -------------------------------------
+    def write_part_keys(self, dataset, shard, entries) -> None:
+        if not entries:
+            return
+        path = self._pk_path(dataset, shard)
+        with open(path, "ab") as f:
+            for e in entries:
+                f.write(_PK_HDR.pack(_PK_MAGIC, len(e.part_key),
+                                     e.start_ts, e.end_ts))
+                f.write(e.part_key)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def scan_part_keys(self, dataset, shard) -> Iterator[PartKeyEntry]:
+        """Latest entry wins per partkey (upsert-by-append)."""
+        path = self._pk_path(dataset, shard)
+        latest: Dict[bytes, PartKeyEntry] = {}
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(_PK_HDR.size)
+                    if len(hdr) < _PK_HDR.size:
+                        break
+                    magic, pk_len, st, en = _PK_HDR.unpack(hdr)
+                    if magic != _PK_MAGIC:
+                        break
+                    pk = f.read(pk_len)
+                    if len(pk) < pk_len:
+                        break
+                    latest[pk] = PartKeyEntry(pk, st, en)
+        return iter(latest.values())
+
+    # -- checkpoints (CheckpointTable.scala:26) ----------------------------
+    def write_checkpoint(self, dataset, shard, group, offset) -> None:
+        path = self._ckpt_path(dataset, shard)
+        cur = self.read_checkpoints(dataset, shard)
+        cur[group] = offset
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in cur.items()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read_checkpoints(self, dataset, shard) -> Dict[int, int]:
+        path = self._ckpt_path(dataset, shard)
+        if not os.path.exists(path):
+            return {}
+        try:
+            with open(path) as f:
+                return {int(k): int(v) for k, v in json.load(f).items()}
+        except (json.JSONDecodeError, OSError):
+            return {}

@@ -1,0 +1,170 @@
+"""Persistence + restart recovery: flush writes chunks/partkeys/checkpoints
+to the ColumnStore; a fresh process bootstraps the index, answers queries
+over aged-out ranges via ODP read-through, and reads the recovery watermark
+from disk.
+
+(Parity model: CassandraColumnStore.scala:54 write :200 readRawPartitions
+:699, CheckpointTable.scala:26, IndexBootstrapper.scala:43,
+OnDemandPagingShard.scala:26.)"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore, TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.store import FlatFileColumnStore, NullColumnStore
+
+REF = DatasetRef("timeseries")
+T0 = 1_600_000_000
+
+
+def _ingest(shard, n_samples=200, n_series=3, t0_s=T0, offset=-1):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s in range(n_series):
+        labels = {"_metric_": "disk_io_total", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": f"i{s}"}
+        for t in range(n_samples):
+            b.add_sample("prom-counter", labels, (t0_s + t * 10) * 1000,
+                         float((t + 1) * 100 * (s + 1)))
+    n = 0
+    for c in b.containers():
+        n += shard.ingest(c, offset)
+    return n
+
+
+def _query(shard, q="rate(disk_io_total[5m])", start=T0 + 600,
+           end=T0 + 1900, step=60):
+    plan = parse_query_range(q, TimeStepParams(start, step, end))
+    return QueryEngine([shard]).execute(plan)
+
+
+def test_restart_recovers_index_chunks_and_watermark(tmp_path):
+    root = str(tmp_path / "col")
+    # -- process 1: ingest, flush with offsets, remember the answer
+    cs1 = FlatFileColumnStore(root)
+    shard1 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=4,
+                             max_chunk_rows=64, column_store=cs1)
+    _ingest(shard1)
+    for g in range(4):
+        shard1.flush_group(g, offset=1000 + g)
+    want = _query(shard1)
+    assert want.num_series == 3 and np.isfinite(want.values).any()
+
+    # -- process 2: fresh store objects over the same directory
+    cs2 = FlatFileColumnStore(root)
+    shard2 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=4,
+                             max_chunk_rows=64, column_store=cs2)
+    n = shard2.bootstrap_from_store()
+    assert n == 3                                # index rebuilt
+    assert shard2.checkpoints == {0: 1000, 1: 1001, 2: 1002, 3: 1003}
+    assert shard2.recovery_watermark() == 1000   # min over groups, from disk
+    got = _query(shard2)                         # pages chunks in via ODP
+    assert shard2.stats.partitions_paged_in == 3
+    gmap = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for i, k in enumerate(want.keys):
+        np.testing.assert_allclose(gmap[k["instance"]], want.values[i],
+                                   rtol=1e-9, equal_nan=True)
+
+
+def test_eviction_then_odp_readthrough(tmp_path):
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=64, column_store=cs)
+    _ingest(shard)
+    shard.flush_all(offset=5)
+    want = _query(shard)
+    # age everything out of memory; index entries stay (ODP shells)
+    n_ev = shard.evict_partitions(cutoff_ts=(T0 + 10_000) * 1000)
+    assert n_ev == 3
+    assert all(p.num_chunks == 0 for p in shard.partitions.values())
+    got = _query(shard)                          # read-through page-in
+    assert shard.stats.partitions_paged_in == 3
+    gmap = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for i, k in enumerate(want.keys):
+        np.testing.assert_allclose(gmap[k["instance"]], want.values[i],
+                                   rtol=1e-9, equal_nan=True)
+
+
+def test_ingest_after_bootstrap_continues_series(tmp_path):
+    root = str(tmp_path / "col")
+    cs1 = FlatFileColumnStore(root)
+    shard1 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs1,
+                             max_chunk_rows=64)
+    _ingest(shard1, n_samples=100)
+    shard1.flush_all(offset=1)
+
+    shard2 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0,
+                             column_store=FlatFileColumnStore(root),
+                             max_chunk_rows=64)
+    shard2.bootstrap_from_store()
+    # ingest the continuation; OOO guard must see the persisted history
+    added = _ingest(shard2, n_samples=100, t0_s=T0 + 1000)
+    assert added == 300
+    dup = _ingest(shard2, n_samples=100)         # replay of old data
+    assert dup == 0                              # all dropped as OOO
+    res = _query(shard2, start=T0 + 600, end=T0 + 1900)
+    assert res.num_series == 3
+    assert np.isfinite(res.values).any()
+
+
+def test_torn_tail_ignored(tmp_path):
+    root = str(tmp_path / "col")
+    cs = FlatFileColumnStore(root)
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                            max_chunk_rows=64)
+    _ingest(shard, n_samples=100)
+    shard.flush_all(offset=1)
+    # simulate a crash mid-append: truncate the chunk log by a few bytes
+    path = cs._chunks_path("timeseries", 0)
+    import os
+    sz = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.truncate(sz - 7)
+    cs2 = FlatFileColumnStore(root)
+    shard2 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs2,
+                             max_chunk_rows=64)
+    shard2.bootstrap_from_store()
+    res = _query(shard2)                         # must not crash
+    assert res.num_series == 3
+
+
+def test_null_column_store_is_noop():
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0,
+                            column_store=NullColumnStore())
+    _ingest(shard, n_samples=50)
+    shard.flush_all(offset=9)
+    assert shard.bootstrap_from_store() == 0
+    assert _query(shard).num_series == 3
+
+
+def test_filoserver_restart_e2e(tmp_path):
+    import json
+    import urllib.request
+
+    from filodb_tpu.standalone.server import FiloServer
+
+    root = str(tmp_path / "data")
+    cfg = {"dataset": "timeseries", "num-shards": 2, "port": 0,
+           "data-dir": root}
+    srv1 = FiloServer(dict(cfg)).start()
+    shard0 = srv1.store.get_shard(DatasetRef("timeseries"), 0)
+    _ingest(shard0)
+    srv1.store.flush_all(DatasetRef("timeseries"))
+    url = (f"/promql/timeseries/api/v1/query_range?"
+           f"query=rate(disk_io_total%5B5m%5D)&start={T0+600}"
+           f"&end={T0+1900}&step=60")
+    r1 = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{srv1.port}{url}"))
+    srv1.stop()
+
+    srv2 = FiloServer(dict(cfg)).start()          # "new process"
+    r2 = json.load(urllib.request.urlopen(
+        f"http://127.0.0.1:{srv2.port}{url}"))
+    srv2.stop()
+    assert r1["data"]["result"], r1
+    assert sorted(json.dumps(s, sort_keys=True)
+                  for s in r2["data"]["result"]) == \
+        sorted(json.dumps(s, sort_keys=True) for s in r1["data"]["result"])
