@@ -1,0 +1,12 @@
+"""Downsampling: device-kernel batch job + query-side resolution selection.
+
+(Reference: core/downsample/ChunkDownsampler.scala:38-353,
+DownsamplePeriodMarker.scala, ShardDownsampler.scala:40;
+spark-jobs/downsampler/chunk/DownsamplerMain.scala:69,
+BatchDownsampler.scala:119,192; query side
+DownsampledTimeSeriesShard.scala:63.)"""
+
+from filodb_tpu.downsample.job import DownsamplerJob, ds_dataset
+from filodb_tpu.downsample.store import DownsampledTimeSeriesStore
+
+__all__ = ["DownsamplerJob", "DownsampledTimeSeriesStore", "ds_dataset"]

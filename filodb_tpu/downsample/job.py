@@ -1,0 +1,231 @@
+"""Downsampler batch job: persisted raw chunks → multi-resolution ds chunks.
+
+The reference runs this as a Spark job over Cassandra token-range splits
+(spark-jobs/downsampler/chunk/DownsamplerMain.scala:69 →
+BatchDownsampler.downsampleBatch :119 → downsamplePart :192: rebuild
+off-heap partition, mark periods, run ChunkDownsamplers per resolution,
+re-encode, persist to the downsample keyspace).
+
+TPU-native shape: one process per shard batch, all per-period math as ONE
+device program per [S, N] tile batch (downsample/kernels.py), host only
+decoding input chunks and encoding output chunks. Output lands in the same
+ColumnStore under the derived dataset ``<dataset>_ds_<res>`` with the
+schema's declared downsample schema (gauge → ds-gauge, prom-counter →
+prom-counter), so the ordinary query path (and the downsampled-store
+resolution selector) reads it like any other dataset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import PartKey, RecordContainer
+from filodb_tpu.core.schemas import (DEFAULT_SCHEMAS, ColumnType, DatasetRef,
+                                     Schemas)
+from filodb_tpu.downsample import kernels
+from filodb_tpu.memory import vectors as bv
+from filodb_tpu.query.tpu import _TS_PAD, _next_pow2
+
+
+def ds_dataset(dataset: str, res_ms: int) -> str:
+    """Derived downsample dataset name (reference: separate downsample
+    keyspace/cluster per resolution, DownsamplerSettings)."""
+    return f"{dataset}_ds_{res_ms}"
+
+
+@dataclass
+class DownsampleStats:
+    partitions_read: int = 0
+    samples_read: int = 0
+    samples_written: int = 0
+    chunks_written: int = 0
+    skipped_schemas: Dict[str, int] = field(default_factory=dict)
+
+
+class DownsamplerJob:
+    """Batch-downsample one shard of one dataset into all resolutions."""
+
+    def __init__(self, column_store, schemas: Optional[Schemas] = None,
+                 resolutions: Sequence[int] = (300_000, 3_600_000),
+                 batch_series: int = 256):
+        self.store = column_store
+        self.schemas = schemas or DEFAULT_SCHEMAS
+        self.resolutions = tuple(resolutions)
+        self.batch_series = batch_series
+
+    # -- input ------------------------------------------------------------
+    def _load_partitions(self, dataset: str, shard: int):
+        """Decode every persisted partition's (ts, value-column) arrays.
+        Yields (part_key, schema, ts, vals)."""
+        for e in self.store.scan_part_keys(dataset, shard):
+            pk = PartKey.from_bytes(e.part_key)
+            schema = self.schemas.by_id(pk.schema_id)
+            vci = schema.value_column_index()
+            col = schema.columns[vci]
+            if col.col_type == ColumnType.HISTOGRAM:
+                yield pk, schema, None, None      # counted as skipped
+                continue
+            ts_parts, val_parts = [], []
+            for c in self.store.read_chunks(dataset, shard, e.part_key):
+                ts_parts.append(bv.decode_longs(c.vectors[0]))
+                val_parts.append(bv.decode_doubles(c.vectors[vci]))
+            if not ts_parts:
+                continue
+            yield (pk, schema, np.concatenate(ts_parts),
+                   np.concatenate(val_parts))
+
+    # -- output -----------------------------------------------------------
+    def _out_shard(self, out_shards: Dict[str, TimeSeriesShard],
+                   dataset: str, res: int, shard: int) -> TimeSeriesShard:
+        name = ds_dataset(dataset, res)
+        sh = out_shards.get(name)
+        if sh is None:
+            sh = TimeSeriesShard(DatasetRef(name), self.schemas, shard,
+                                 column_store=self.store)
+            out_shards[name] = sh
+        return sh
+
+    # -- the job ----------------------------------------------------------
+    def run(self, dataset: str, shard: int,
+            start_ms: Optional[int] = None,
+            end_ms: Optional[int] = None) -> DownsampleStats:
+        stats = DownsampleStats()
+        gauges: List[Tuple[PartKey, object, np.ndarray, np.ndarray]] = []
+        counters: List[Tuple[PartKey, object, np.ndarray, np.ndarray]] = []
+        for pk, schema, ts, vals in self._load_partitions(dataset, shard):
+            if ts is None or not schema.downsamplers:
+                stats.skipped_schemas[schema.name] = \
+                    stats.skipped_schemas.get(schema.name, 0) + 1
+                continue
+            if start_ms is not None or end_ms is not None:
+                lo = np.searchsorted(ts, start_ms or 0, side="left")
+                hi = np.searchsorted(ts, end_ms or (1 << 62), side="right")
+                ts, vals = ts[lo:hi], vals[lo:hi]
+            if not ts.size:
+                continue
+            stats.partitions_read += 1
+            stats.samples_read += int(ts.size)
+            marker = schema.downsample_period_marker
+            (counters if marker.startswith("counter") else gauges).append(
+                (pk, schema, ts, vals))
+
+        out_shards: Dict[str, TimeSeriesShard] = {}
+        for batch in _batches(gauges, self.batch_series):
+            self._downsample_gauge_batch(batch, dataset, shard,
+                                         out_shards, stats)
+        for res in self.resolutions:
+            for batch in _batches(counters, self.batch_series):
+                self._downsample_counter_batch(batch, dataset, shard, res,
+                                               out_shards, stats)
+        for sh in out_shards.values():
+            sh.flush_all()
+        stats.chunks_written = sum(
+            s.stats.chunks_persisted for s in out_shards.values())
+        return stats
+
+    def _pack(self, batch):
+        S = len(batch)
+        maxlen = max(ts.size for _, _, ts, _ in batch)
+        N = _next_pow2(maxlen)
+        ts_pad = np.full((S, N), _TS_PAD, dtype=np.int64)
+        vals_pad = np.zeros((S, N), dtype=np.float64)
+        lens = np.zeros(S, dtype=np.int32)
+        t_lo, t_hi = None, None
+        for i, (_, _, ts, vals) in enumerate(batch):
+            m = ~np.isnan(vals)
+            ts, vals = ts[m], vals[m]
+            n = ts.size
+            ts_pad[i, :n] = ts
+            vals_pad[i, :n] = vals
+            lens[i] = n
+            if n:
+                t_lo = int(ts[0]) if t_lo is None else min(t_lo, int(ts[0]))
+                t_hi = int(ts[-1]) if t_hi is None else max(t_hi,
+                                                            int(ts[-1]))
+        return ts_pad, vals_pad, lens, t_lo, t_hi
+
+    @staticmethod
+    def _w_bound(ts_pad, lens, res) -> int:
+        """Static samples-per-period cap for the min/max gather."""
+        d = np.diff(ts_pad, axis=1)
+        valid = (np.arange(1, ts_pad.shape[1])[None, :] < lens[:, None])
+        d = d[valid & (d > 0)]
+        min_dt = int(d.min()) if d.size else res
+        return min(_next_pow2(int(res // max(min_dt, 1)) + 2, 4),
+                   max(int(ts_pad.shape[1]), 4))
+
+    def _downsample_gauge_batch(self, batch, dataset, shard,
+                                out_shards, stats) -> None:
+        """All resolutions for one gauge batch: the finest level reads raw
+        tiles, coarser levels cascade from the previous level (sum of sums,
+        min of mins, ... — the multi-resolution trick that keeps device
+        work O(samples + total periods))."""
+        ts_pad, vals_pad, lens, t_lo, t_hi = self._pack(batch)
+        if t_lo is None:
+            return
+        prev = prev_res = None
+        for res in sorted(self.resolutions):
+            base = (t_lo // res) * res
+            nperiods = int((t_hi - base) // res) + 1
+            if prev is not None and res % prev_res == 0:
+                wb = _next_pow2(res // prev_res + 2, 4)
+                arrays = kernels.cascade_gauge(prev, np.int64(base),
+                                               np.int64(res), nperiods, wb)
+            else:
+                wb = self._w_bound(ts_pad, lens, res)
+                arrays = kernels.downsample_gauge_tiles(
+                    ts_pad, vals_pad, lens, np.int64(base), np.int64(res),
+                    nperiods, wb)
+            self._emit_gauge(batch, [np.asarray(a) for a in arrays],
+                             dataset, res, shard, out_shards, stats)
+            prev, prev_res = arrays, res
+
+    def _emit_gauge(self, batch, arrays, dataset, res, shard, out_shards,
+                    stats) -> None:
+        sums, cnts, mins, maxs, last_v, last_ts = arrays
+        out = self._out_shard(out_shards, dataset, res, shard)
+        ds_schema = self.schemas.by_name("ds-gauge")
+        for i, (pk, schema, _, _) in enumerate(batch):
+            has = cnts[i] > 0
+            if not has.any():
+                continue
+            cont = RecordContainer(ds_schema)
+            out_pk = PartKey(ds_schema.schema_id, pk.labels)
+            c = cnts[i][has]
+            for t, mn, mx, s, cc in zip(last_ts[i][has], mins[i][has],
+                                        maxs[i][has], sums[i][has], c):
+                cont.add(out_pk, int(t), mn, mx, s, cc, s / cc)
+                stats.samples_written += 1
+            out.ingest(cont)
+
+    def _downsample_counter_batch(self, batch, dataset, shard, res,
+                                  out_shards, stats) -> None:
+        ts_pad, vals_pad, lens, t_lo, t_hi = self._pack(batch)
+        if t_lo is None:
+            return
+        base = (t_lo // res) * res
+        nperiods = int((t_hi - base) // res) + 1
+        mask = np.asarray(kernels.counter_emit_mask(
+            ts_pad, vals_pad, lens, np.int64(base), np.int64(res), nperiods))
+        out = self._out_shard(out_shards, dataset, res, shard)
+        for i, (pk, schema, _, _) in enumerate(batch):
+            m = mask[i]
+            if not m.any():
+                continue
+            ds_name = schema.downsample_schema or schema.name
+            ds_schema = self.schemas.by_name(ds_name)
+            cont = RecordContainer(ds_schema)
+            out_pk = PartKey(ds_schema.schema_id, pk.labels)
+            for t, v in zip(ts_pad[i][m], vals_pad[i][m]):
+                cont.add(out_pk, int(t), float(v))
+                stats.samples_written += 1
+            out.ingest(cont)
+
+
+def _batches(items, size):
+    for i in range(0, len(items), size):
+        yield items[i:i + size]

@@ -1,0 +1,164 @@
+"""Device downsample kernels: per-period aggregates over [S, N] tiles.
+
+The reference computes one value per chunk per period with per-row iterator
+``ChunkDownsampler``s (core/downsample/ChunkDownsampler.scala:38-353 —
+SumDownsampler, CountDownsampler, MinDownsampler, MaxDownsampler,
+AvgDownsampler, LastValueDDownsampler, TimeDownsampler) driven by
+``DownsamplePeriodMarker`` row ranges (time-aligned, plus counter-correction
+boundaries for counters).
+
+Here the whole batch is one fused XLA program: period assignment is integer
+arithmetic per sample, aggregation is scatter-add/min/max onto a dense
+[S, P] period grid (same trick as the query engine's window bounds — the
+scatter rides the VPU, results stay on device until the host encodes
+chunks). Counter period boundaries (resets) come out as an emit mask, since
+counter downsampling persists boundary samples rather than aggregates.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+
+@functools.partial(jax.jit, static_argnames=("nperiods", "w_bound"))
+def downsample_gauge_tiles(ts, vals, lens, base, res, nperiods: int,
+                           w_bound: int = 64):
+    """Per-period (sum, count, min, max, last_v, last_ts) for gauge tiles.
+
+    Period p = (ts - base) // res; samples outside [0, nperiods) and row
+    padding are dropped; empty periods are NaN. (dSum/dCount/dMin/dMax/
+    dAvg/tTime of the gauge schema in one pass; avg = sum/count is
+    computed by the caller.)
+
+    Timestamps are sorted per row, so periods are CONTIGUOUS index ranges:
+    this is the query engine's uniform window machinery with
+    window == step == res — int32 scatter-histogram bounds + f64 prefix
+    sums + a [S, P, W] bounded gather for the order statistics. (A direct
+    f64 scatter-add/min/max onto [S, P] lowers to a serialized TPU scatter
+    and ran ~500x slower.) ``w_bound`` is a static cap on samples per
+    period for the min/max gather."""
+    from filodb_tpu.query.tpu import _bounds, _prefix, _take
+
+    S, N = ts.shape
+    idx = jnp.arange(N)[None, :]
+    valid = idx < lens[:, None]
+    ts = jnp.where(valid, ts, jnp.int64(1) << 60)   # pad -> no period
+    lo, hi = _bounds(ts, base, base + res - 1, res, nperiods)   # [S, P]
+    counts = (hi - lo + 1).astype(jnp.float64)
+    has = counts >= 1
+    nan = jnp.nan
+    v = jnp.where(valid, vals, 0.0)
+    cs = _prefix(v)
+    sums = _take(cs, jnp.clip(hi + 1, 0, N)) - _take(cs, jnp.clip(lo, 0, N))
+    hi_c = jnp.clip(hi, 0, N - 1)
+    last_v = _take(vals, hi_c)
+    last_ts = _take(ts, hi_c)
+    # order statistics: bounded gather over each period's index range
+    offs = jnp.arange(w_bound)
+    gidx = lo[:, :, None] + offs[None, None, :]          # [S, P, W]
+    in_p = (gidx <= hi[:, :, None]) & (gidx < lens[:, None, None])
+    gidx_c = jnp.clip(gidx, 0, N - 1)
+    g = jnp.take_along_axis(vals, gidx_c.reshape(S, -1), axis=1).reshape(
+        gidx.shape)
+    mins = jnp.min(jnp.where(in_p, g, jnp.inf), axis=2)
+    maxs = jnp.max(jnp.where(in_p, g, -jnp.inf), axis=2)
+    return (jnp.where(has, sums, nan), jnp.where(has, counts, 0.0),
+            jnp.where(has, mins, nan), jnp.where(has, maxs, nan),
+            jnp.where(has, last_v, nan),
+            jnp.where(has, last_ts, jnp.int64(0)))
+
+
+def cascade_gauge(prev, base, res, nperiods: int, w_bound: int):
+    """Downsample one resolution level from the previous level's outputs
+    (sum of sums, count of counts, min of mins, max of maxes, last of
+    lasts) — the multi-resolution cascade: only the finest level reads raw
+    samples. ``prev`` is the previous level's 6-tuple."""
+    p_sums, p_cnts, p_mins, p_maxs, p_last_v, p_last_ts = prev
+    S, P = p_sums.shape
+    has = p_cnts > 0
+    pts = jnp.where(has, p_last_ts, jnp.int64(1) << 60)  # empty -> dropped
+    lens = jnp.full((S,), P, dtype=jnp.int32)
+
+    def run(chan):
+        return downsample_gauge_tiles(pts, jnp.where(has, chan, 0.0), lens,
+                                      base, res, nperiods, w_bound)
+
+    s_out = run(p_sums)
+    c_out = run(p_cnts)
+    m_out = run(p_mins)
+    x_out = run(p_maxs)
+    l_out = run(p_last_v)
+    counts = jnp.where(jnp.isnan(c_out[0]), 0.0, c_out[0])
+    return (s_out[0], counts, m_out[2], x_out[3], l_out[4], s_out[5])
+
+
+@functools.partial(jax.jit, static_argnames=("nperiods",))
+def counter_emit_mask(ts, vals, lens, base, res, nperiods: int):
+    """Emit mask for counter downsampling: keep the LAST sample of every
+    period plus BOTH sides of every reset — the peak right before it and
+    the reset sample itself (DownsamplePeriodMarker counter boundaries,
+    DownsamplePeriodMarker.scala; dLast of prom-counter).
+
+    Emitting both sides makes every drop visible to query-time counter
+    correction even when the counter climbs back above the old peak before
+    the period ends, so sum-of-increases over the emitted rows equals the
+    raw correction's from any emitted baseline onward."""
+    S, N = ts.shape
+    idx = jnp.arange(N)[None, :]
+    valid = idx < lens[:, None]
+    p = ((ts - base) // jnp.maximum(res, 1)).astype(jnp.int32)
+    p_ok = valid & (p >= 0) & (p < nperiods)
+    # rows are time-sorted: a sample is last-in-period iff its successor is
+    # invalid or falls in a different period (pure lane arithmetic — no
+    # scatter, which TPU would serialize)
+    nxt_p = jnp.concatenate([p[:, 1:],
+                             jnp.full((S, 1), -1, p.dtype)], axis=1)
+    nxt_valid = jnp.concatenate([valid[:, 1:],
+                                 jnp.zeros((S, 1), bool)], axis=1)
+    is_last = ~nxt_valid | (nxt_p != p)
+    nxt = jnp.concatenate([vals[:, 1:], vals[:, -1:]], axis=1)
+    peak = (nxt < vals) & nxt_valid                       # next is a reset
+    prev = jnp.concatenate([vals[:, :1], vals[:, :-1]], axis=1)
+    is_reset = (vals < prev) & (idx > 0) & valid          # first after drop
+    return (is_last | peak | is_reset) & p_ok
+
+
+# ---------------------------------------------------------------------------
+# numpy oracle (parity model for the kernels)
+# ---------------------------------------------------------------------------
+
+def downsample_gauge_oracle(ts: np.ndarray, vals: np.ndarray, base: int,
+                            res: int, nperiods: int
+                            ) -> Tuple[np.ndarray, ...]:
+    """Reference semantics, one series, plain numpy loops."""
+    sums = np.full(nperiods, np.nan)
+    cnts = np.zeros(nperiods)
+    mins = np.full(nperiods, np.nan)
+    maxs = np.full(nperiods, np.nan)
+    last_v = np.full(nperiods, np.nan)
+    last_ts = np.zeros(nperiods, dtype=np.int64)
+    for t, v in zip(ts, vals):
+        p = (int(t) - base) // res
+        if not (0 <= p < nperiods):
+            continue
+        if cnts[p] == 0:
+            sums[p] = v
+            mins[p] = v
+            maxs[p] = v
+        else:
+            sums[p] += v
+            mins[p] = min(mins[p], v)
+            maxs[p] = max(maxs[p], v)
+        cnts[p] += 1
+        last_v[p] = v
+        last_ts[p] = t
+    return sums, cnts, mins, maxs, last_v, last_ts

@@ -1,0 +1,113 @@
+"""Query-side downsample store: resolution selection + plan rewriting.
+
+(Reference: DownsampledTimeSeriesShard.scala:63 — query-only shards over
+downsampled data, resolution chosen per query; the gauge query path reads
+the ds-gauge column matching the range function. LongTimeRangePlanner
+splits raw vs downsample by retention — the split/stitch lives in the
+planner layer; this store answers the downsample side.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef, Schemas
+from filodb_tpu.downsample.job import ds_dataset
+from filodb_tpu.query import logical as lp
+
+# range function -> (ds-gauge column, function to run over that column)
+# min of per-period minima is the min; sums/counts add; avg falls back to
+# the avg column (exact when windows nest periods, the standard ds tradeoff)
+_GAUGE_REWRITES: Dict[str, Tuple[str, str]] = {
+    "min_over_time": ("min", "min_over_time"),
+    "max_over_time": ("max", "max_over_time"),
+    "sum_over_time": ("sum", "sum_over_time"),
+    "count_over_time": ("count", "sum_over_time"),
+    "avg_over_time": ("avg", "avg_over_time"),
+    "last_over_time": ("avg", "last_over_time"),
+}
+
+
+def select_resolution(resolutions: Sequence[int], window_ms: int,
+                      step_ms: int) -> Optional[int]:
+    """Coarsest resolution that still gives every window >= 2 periods
+    (DownsampledTimeSeriesShard pickles resolution by query range)."""
+    best = None
+    for res in sorted(resolutions):
+        if window_ms >= 2 * res and step_ms >= res:
+            best = res
+    return best
+
+
+def rewrite_plan(plan, resolution_ms: int):
+    """Rewrite a LogicalPlan to run against ds data: gauge over-time
+    functions select the matching ds-gauge column. Counter functions
+    (rate/increase) read the same value column and need no rewrite —
+    counter downsampling preserved boundary samples."""
+    if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        rw = _GAUGE_REWRITES.get(plan.function)
+        if rw is None:
+            return plan
+        col, func = rw
+        raw = dataclasses.replace(plan.raw, column=plan.raw.column or col)
+        return dataclasses.replace(plan, raw=raw, function=func)
+    if hasattr(plan, "__dataclass_fields__"):
+        changes = {}
+        for f in plan.__dataclass_fields__:
+            v = getattr(plan, f)
+            if isinstance(v, tuple):
+                nv = tuple(rewrite_plan(x, resolution_ms)
+                           if hasattr(x, "__dataclass_fields__") else x
+                           for x in v)
+                if nv != v:
+                    changes[f] = nv
+            elif hasattr(v, "__dataclass_fields__"):
+                nv = rewrite_plan(v, resolution_ms)
+                if nv is not v:
+                    changes[f] = nv
+        if changes:
+            return dataclasses.replace(plan, **changes)
+    return plan
+
+
+class DownsampledTimeSeriesStore:
+    """Read-only store over the downsample datasets of one raw dataset.
+
+    ``shards_for`` picks the resolution for a query and returns the shard
+    set (bootstrapped lazily from the ColumnStore) plus the rewritten
+    plan; callers hand both to the ordinary engine/planner — downsampled
+    chunks are ordinary chunks."""
+
+    def __init__(self, column_store, dataset: str, num_shards: int,
+                 resolutions: Sequence[int] = (300_000, 3_600_000),
+                 schemas: Optional[Schemas] = None):
+        self.store = column_store
+        self.dataset = dataset
+        self.num_shards = num_shards
+        self.resolutions = tuple(sorted(resolutions))
+        self.schemas = schemas or DEFAULT_SCHEMAS
+        self._shards: Dict[int, List[TimeSeriesShard]] = {}
+
+    def shards_for_resolution(self, res: int) -> List[TimeSeriesShard]:
+        got = self._shards.get(res)
+        if got is None:
+            name = ds_dataset(self.dataset, res)
+            got = []
+            for sh in range(self.num_shards):
+                shard = TimeSeriesShard(DatasetRef(name), self.schemas, sh,
+                                        column_store=self.store)
+                shard.bootstrap_from_store()
+                got.append(shard)
+            self._shards[res] = got
+        return got
+
+    def plan_query(self, plan, window_ms: int, step_ms: int
+                   ) -> Optional[Tuple[List[TimeSeriesShard], object]]:
+        """(shards, rewritten_plan) when a downsample resolution can serve
+        this query, else None (caller uses the raw store)."""
+        res = select_resolution(self.resolutions, window_ms, step_ms)
+        if res is None:
+            return None
+        return self.shards_for_resolution(res), rewrite_plan(plan, res)

@@ -1,0 +1,197 @@
+"""Downsampler: device kernel parity vs the numpy oracle, counter boundary
+preservation, and the batch job end-to-end (raw chunks -> ds chunks ->
+query at coarse resolution).
+
+(Parity model: core/downsample ChunkDownsamplerSpec / ShardDownsampler
+tests; BatchDownsampler.scala:119.)"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.downsample import (DownsampledTimeSeriesStore,
+                                   DownsamplerJob, ds_dataset)
+from filodb_tpu.downsample import kernels
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.store import FlatFileColumnStore
+
+REF = DatasetRef("timeseries")
+RES = 300_000
+# period-aligned epoch; samples sit 5s past boundaries so windows aligned
+# to periods nest them exactly (inclusive-bounds windows never pick up a
+# boundary sample from a neighboring period)
+T0_MS = (1_600_000_000_000 // RES) * RES
+SAMPLE_OFF = 5_000
+
+
+def test_gauge_kernel_matches_oracle():
+    rng = np.random.default_rng(3)
+    S, N = 5, 700
+    ts = np.sort(T0_MS + rng.integers(0, 3_600_000, (S, N)), axis=1)
+    # force strictly increasing
+    ts = ts + np.arange(N)[None, :]
+    vals = rng.normal(50, 20, (S, N))
+    lens = np.full(S, N, dtype=np.int32)
+    lens[2] = 300                      # one short row
+    base = (int(ts.min()) // RES) * RES
+    nperiods = int((ts.max() - base) // RES) + 1
+    sums, cnts, mins, maxs, last_v, last_ts = [
+        np.asarray(a) for a in kernels.downsample_gauge_tiles(
+            ts, vals, lens, np.int64(base), np.int64(RES), nperiods)]
+    for i in range(S):
+        o = kernels.downsample_gauge_oracle(ts[i, :lens[i]],
+                                            vals[i, :lens[i]], base, RES,
+                                            nperiods)
+        has = o[1] > 0
+        np.testing.assert_allclose(sums[i][has], o[0][has], rtol=1e-12)
+        np.testing.assert_array_equal(cnts[i], o[1])
+        np.testing.assert_allclose(mins[i][has], o[2][has])
+        np.testing.assert_allclose(maxs[i][has], o[3][has])
+        np.testing.assert_allclose(last_v[i][has], o[4][has])
+        np.testing.assert_array_equal(last_ts[i][has], o[5][has])
+        assert np.all(np.isnan(sums[i][~has]))
+
+
+def test_counter_emit_mask_keeps_period_lasts_and_peaks():
+    ts = np.arange(1, 61, dtype=np.int64)[None, :] * 10_000 + T0_MS
+    vals = np.cumsum(np.full(60, 5.0))
+    vals[30:] = np.cumsum(np.full(30, 5.0))        # reset at index 30
+    vals = vals[None, :]
+    lens = np.array([60], dtype=np.int32)
+    base = (int(ts.min()) // RES) * RES
+    nperiods = int((ts.max() - base) // RES) + 1
+    mask = np.asarray(kernels.counter_emit_mask(
+        ts, vals, lens, np.int64(base), np.int64(RES), nperiods))[0]
+    assert mask[29]                                # peak before reset
+    assert mask[30]                                # reset sample itself
+    # last sample of every period kept
+    p = (ts[0] - base) // RES
+    for period in np.unique(p):
+        last_idx = np.max(np.where(p == period))
+        assert mask[last_idx], period
+    # downsampled increase == raw increase from the first emitted baseline
+    # (sum over reset-corrected deltas)
+    def total_increase(v):
+        d = np.diff(v)
+        return float(np.where(d < 0, v[1:], d).sum())
+    i0 = int(np.argmax(mask))
+    raw = total_increase(vals[0][i0:])
+    dsm = total_increase(vals[0][mask])
+    assert dsm == pytest.approx(raw)
+
+
+def _seed_raw(root):
+    cs = FlatFileColumnStore(root)
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, max_chunk_rows=128,
+                            column_store=cs)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    rng = np.random.default_rng(11)
+    for s in range(4):
+        labels = {"_metric_": "cpu_seconds", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": f"i{s}"}
+        for t in range(720):                      # 2h at 10s
+            b.add_sample("gauge", labels, T0_MS + SAMPLE_OFF + t * 10_000,
+                         float(rng.normal(50, 10)))
+    for s in range(2):
+        labels = {"_metric_": "reqs_total", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": f"i{s}"}
+        v = 0.0
+        for t in range(720):
+            v += 7.0 * (s + 1)
+            b.add_sample("prom-counter", labels,
+                         T0_MS + SAMPLE_OFF + t * 10_000, v)
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all(offset=1)
+    return cs, shard
+
+
+def test_job_end_to_end_and_query_parity(tmp_path):
+    cs, raw_shard = _seed_raw(str(tmp_path / "col"))
+    job = DownsamplerJob(cs, resolutions=(RES,))
+    stats = job.run("timeseries", 0)
+    assert stats.partitions_read == 6
+    assert stats.samples_read == 6 * 720
+    assert stats.samples_written > 0 and stats.chunks_written > 0
+
+    dstore = DownsampledTimeSeriesStore(cs, "timeseries", 1,
+                                        resolutions=(RES,))
+    start_s = T0_MS // 1000 + 1800
+    end_s = T0_MS // 1000 + 7000
+    tsp = TimeStepParams(start_s, 600, end_s)
+
+    # gauge min/max/sum/count over nested windows: EXACT parity with raw
+    for q in ["min_over_time(cpu_seconds[10m])",
+              "max_over_time(cpu_seconds[10m])",
+              "sum_over_time(cpu_seconds[10m])",
+              "count_over_time(cpu_seconds[10m])"]:
+        plan = parse_query_range(q, tsp)
+        picked = dstore.plan_query(plan, 600_000, 600_000)
+        assert picked is not None, q
+        ds_shards, ds_plan = picked
+        got = QueryEngine(ds_shards).execute(ds_plan)
+        want = QueryEngine([raw_shard]).execute(plan)
+        gmap = {k["instance"]: got.values[i]
+                for i, k in enumerate(got.keys)}
+        assert len(gmap) == want.num_series, q
+        for i, k in enumerate(want.keys):
+            np.testing.assert_allclose(
+                gmap[k["instance"]], want.values[i], rtol=1e-9,
+                equal_nan=True, err_msg=q)
+
+    # counter rate over downsampled boundary samples: windows aligned to
+    # periods see the same increase as raw
+    plan = parse_query_range("increase(reqs_total[10m])", tsp)
+    picked = dstore.plan_query(plan, 600_000, 600_000)
+    assert picked is not None
+    ds_shards, ds_plan = picked
+    got = QueryEngine(ds_shards).execute(ds_plan)
+    want = QueryEngine([raw_shard]).execute(plan)
+    gmap = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for i, k in enumerate(want.keys):
+        g, w = gmap[k["instance"]], want.values[i]
+        ok = np.isfinite(w) & np.isfinite(g)
+        assert ok.sum() >= w.size - 2
+        # extrapolated rate over sparser points: allow small tolerance
+        np.testing.assert_allclose(g[ok], w[ok], rtol=0.05)
+
+
+def test_resolution_selection():
+    from filodb_tpu.downsample.store import select_resolution
+    assert select_resolution((300_000, 3_600_000), 600_000, 300_000) == \
+        300_000
+    assert select_resolution((300_000, 3_600_000), 7_200_000,
+                             3_600_000) == 3_600_000
+    assert select_resolution((300_000, 3_600_000), 300_000, 60_000) is None
+
+
+def test_cascade_matches_direct():
+    """1h level cascaded from 5m level == 1h computed direct from raw."""
+    rng = np.random.default_rng(9)
+    S, N = 3, 2000
+    ts = np.sort(T0_MS + rng.integers(0, 6 * 3_600_000, (S, N)), axis=1)
+    ts = ts + np.arange(N)[None, :]
+    vals = rng.normal(0, 100, (S, N))
+    lens = np.full(S, N, dtype=np.int32)
+    lens[1] = 1200
+    base5 = (int(ts.min()) // RES) * RES
+    res_h = 3_600_000
+    base_h = (int(ts.min()) // res_h) * res_h
+    np5 = int((ts.max() - base5) // RES) + 1
+    nph = int((ts.max() - base_h) // res_h) + 1
+    fine = kernels.downsample_gauge_tiles(ts, vals, lens, np.int64(base5),
+                                          np.int64(RES), np5, 64)
+    casc = [np.asarray(a) for a in kernels.cascade_gauge(
+        fine, np.int64(base_h), np.int64(res_h), nph, 16)]
+    direct = [np.asarray(a) for a in kernels.downsample_gauge_tiles(
+        ts, vals, lens, np.int64(base_h), np.int64(res_h), nph, 2048)]
+    for c, d, name in zip(casc, direct,
+                          ["sum", "count", "min", "max", "last", "last_ts"]):
+        if name == "last_ts":
+            np.testing.assert_array_equal(c, d, err_msg=name)
+        else:
+            np.testing.assert_allclose(c, d, rtol=1e-9, atol=1e-9,
+                                       equal_nan=True, err_msg=name)
