@@ -79,7 +79,7 @@ class TimeSeriesPartition:
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
                  "ingested", "ooo_dropped", "_decode_cache", "_merge_cache",
-                 "persisted_chunks", "odp_pending")
+                 "persisted_chunks", "odp_pending", "_cache_lock")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -101,6 +101,10 @@ class TimeSeriesPartition:
         self._merge_cache: Dict[int, Tuple] = {}
         self.persisted_chunks = 0   # prefix of `chunks` already in the store
         self.odp_pending = False    # True: chunks live in the ColumnStore
+        # guards _decode_cache/_merge_cache population: concurrent HTTP
+        # query threads share these caches (the chunk list itself is only
+        # appended to, and readers work off a snapshot length)
+        self._cache_lock = threading.Lock()
 
     # -- write path -------------------------------------------------------
     def ingest(self, timestamp: int, values: Sequence) -> bool:
@@ -164,16 +168,26 @@ class TimeSeriesPartition:
             vectors=tuple(vecs),
         )
         self._chunk_seq += 1
-        self.chunks.append(info)
-        self._ts_buf = []
-        self._col_bufs = [[] for _ in self.schema.data_columns]
+        # publish atomically w.r.t. readers: a reader must never see the new
+        # chunk AND the old buffer tail (double count) or neither (drop)
+        with self._cache_lock:
+            self.chunks.append(info)
+            self._ts_buf = []
+            self._col_bufs = [[] for _ in self.schema.data_columns]
         return info
 
     # -- read path --------------------------------------------------------
     def buffer_snapshot(self):
-        """Snapshot of the un-encoded tail (timestamps, per-column lists)."""
-        return (np.asarray(self._ts_buf, dtype=np.int64),
-                [list(b) for b in self._col_bufs])
+        """Snapshot of the un-encoded tail (timestamps, per-column lists).
+
+        Ingest appends the timestamp first, then each column value, so the
+        longest consistent prefix across all buffers is a valid row set even
+        when a writer thread is mid-append."""
+        ts = list(self._ts_buf)
+        cols = [list(b) for b in self._col_bufs]
+        n = min([len(ts)] + [len(c) for c in cols]) if cols else len(ts)
+        return (np.asarray(ts[:n], dtype=np.int64),
+                [c[:n] for c in cols])
 
     def _decoded_chunk_arrays(self, col_index: int
                               ) -> Tuple[np.ndarray, np.ndarray]:
@@ -182,19 +196,25 @@ class TimeSeriesPartition:
         decoded. This is the host mirror of the device tile store — decode
         cost is paid once per chunk, not once per query."""
         col = self.schema.columns[col_index]
+        with self._cache_lock:
+            return self._decoded_chunk_arrays_locked(col_index, col)
+
+    def _decoded_chunk_arrays_locked(self, col_index: int, col):
+        """Body of _decoded_chunk_arrays; caller holds ``_cache_lock``."""
         entry = self._decode_cache.get(col_index)
         if entry is None:
             entry = [0, [], [], None]
             self._decode_cache[col_index] = entry
-        if entry[0] < len(self.chunks):
-            for c in self.chunks[entry[0]:]:
+        n = len(self.chunks)
+        if entry[0] < n:
+            for c in self.chunks[entry[0]:n]:
                 entry[1].append(bv.decode_longs(c.vectors[0]))
                 if col.col_type == ColumnType.HISTOGRAM:
                     _, _, vals = bh.decode_histograms(c.vectors[col_index])
                 else:
                     vals = bv.decode_doubles(c.vectors[col_index])
                 entry[2].append(vals)
-            entry[0] = len(self.chunks)
+            entry[0] = n
             entry[3] = None
         if entry[3] is None:
             if entry[1]:
@@ -213,7 +233,7 @@ class TimeSeriesPartition:
             for a in cat:
                 a.setflags(write=False)
             entry[3] = cat
-        return entry[3]
+        return entry[0], entry[3]
 
     def read_full(self, col_index: int
                   ) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -222,13 +242,19 @@ class TimeSeriesPartition:
         chunk_len is the length of the chunk-backed (immutable) prefix —
         downstream device caches key on it (num_chunks pins its content)."""
         col = self.schema.columns[col_index]
-        cts, cvals = self._decoded_chunk_arrays(col_index)
-        buf_ts, buf_cols = self.buffer_snapshot()
+        # one lock acquisition covers decode AND the tail snapshot: a
+        # switch_buffers publishing the tail as a chunk between the two
+        # would otherwise double-count (chunk seen + tail still seen) or
+        # drop (neither seen) those rows
+        with self._cache_lock:
+            n_chunks, (cts, cvals) = \
+                self._decoded_chunk_arrays_locked(col_index, col)
+            buf_ts, buf_cols = self.buffer_snapshot()
         if not buf_ts.size:
             self._merge_cache.pop(col_index, None)
             return cts, cvals, cts.size
         cached = self._merge_cache.get(col_index)
-        if cached is not None and cached[0] == len(self.chunks) \
+        if cached is not None and cached[0] == n_chunks \
                 and cached[1] == buf_ts.size:
             return cached[2], cached[3], cts.size
         if col.col_type == ColumnType.HISTOGRAM:
@@ -245,8 +271,7 @@ class TimeSeriesPartition:
         mvals = np.concatenate([cvals, tail], axis=0)
         mts.setflags(write=False)
         mvals.setflags(write=False)
-        self._merge_cache[col_index] = (len(self.chunks), buf_ts.size,
-                                        mts, mvals)
+        self._merge_cache[col_index] = (n_chunks, buf_ts.size, mts, mvals)
         return mts, mvals, cts.size
 
     def read_range(self, start_ts: int, end_ts: int, col_index: int
@@ -458,11 +483,15 @@ class TimeSeriesShard:
             infos = [ChunkSetInfo(c.chunk_id, c.num_rows, c.start_ts,
                                   c.end_ts, c.vectors)
                      for c in loaded if c.chunk_id not in have]
-            part.chunks = infos + part.chunks
-            part.persisted_chunks += len(infos)
-            part._chunk_seq = max(part._chunk_seq, len(part.chunks))
-            part._decode_cache.clear()
-            part._merge_cache.clear()
+            # prepending invalidates the decoded-prefix caches; swap the
+            # list and clear them under the partition's cache lock so a
+            # concurrent reader can't repopulate against the old prefix
+            with part._cache_lock:
+                part.chunks = infos + part.chunks
+                part.persisted_chunks += len(infos)
+                part._chunk_seq = max(part._chunk_seq, len(part.chunks))
+                part._decode_cache.clear()
+                part._merge_cache.clear()
             part.odp_pending = False
             self.stats.partitions_paged_in += 1
 
@@ -511,11 +540,15 @@ class TimeSeriesShard:
                     self.index.start_time(pid)
                     or part.earliest_timestamp or 0,
                     part.last_timestamp or 0))
-                part.chunks = []
-                part.persisted_chunks = 0
-                part._decode_cache.clear()
-                part._merge_cache.clear()
-                part.odp_pending = True
+                with part._cache_lock:
+                    # flag BEFORE clearing: a concurrent lookup must either
+                    # see the data or see the page-in flag, never an empty
+                    # unflagged partition
+                    part.odp_pending = True
+                    part.chunks = []
+                    part.persisted_chunks = 0
+                    part._decode_cache.clear()
+                    part._merge_cache.clear()
             if entries:
                 self.column_store.write_part_keys(
                     self.ref.dataset, self.shard_num, entries)

@@ -19,14 +19,16 @@ from filodb_tpu.query import logical as lp
 
 # range function -> (ds-gauge column, function to run over that column)
 # min of per-period minima is the min; sums/counts add; avg falls back to
-# the avg column (exact when windows nest periods, the standard ds tradeoff)
+# the avg column (exact when windows nest periods, the standard ds tradeoff).
+# last_over_time is deliberately absent: ds-gauge (matching the reference
+# schema) has no `last` column, and mapping it to `avg` would silently
+# return the period average — those queries fall back to raw data.
 _GAUGE_REWRITES: Dict[str, Tuple[str, str]] = {
     "min_over_time": ("min", "min_over_time"),
     "max_over_time": ("max", "max_over_time"),
     "sum_over_time": ("sum", "sum_over_time"),
     "count_over_time": ("count", "sum_over_time"),
     "avg_over_time": ("avg", "avg_over_time"),
-    "last_over_time": ("avg", "last_over_time"),
 }
 
 
@@ -45,11 +47,20 @@ def rewrite_plan(plan, resolution_ms: int):
     """Rewrite a LogicalPlan to run against ds data: gauge over-time
     functions select the matching ds-gauge column. Counter functions
     (rate/increase) read the same value column and need no rewrite —
-    counter downsampling preserved boundary samples."""
+    counter downsampling preserved boundary samples.
+
+    Returns None when the plan contains a window function the downsample
+    schema cannot serve exactly (e.g. last_over_time, quantile_over_time on
+    ds-gauge) — the caller must fall back to raw data."""
     if isinstance(plan, lp.PeriodicSeriesWithWindowing):
         rw = _GAUGE_REWRITES.get(plan.function)
         if rw is None:
-            return plan
+            from filodb_tpu.query.rangefn import COUNTER_FUNCTIONS
+            if plan.function in COUNTER_FUNCTIONS or plan.function == "delta":
+                return plan     # counter ds preserved boundary samples
+            # every other window function (changes, deriv, quantile_over_
+            # time, holt_winters, ...) has no exact ds column: use raw
+            return None
         col, func = rw
         raw = dataclasses.replace(plan.raw, column=plan.raw.column or col)
         return dataclasses.replace(plan, raw=raw, function=func)
@@ -58,13 +69,22 @@ def rewrite_plan(plan, resolution_ms: int):
         for f in plan.__dataclass_fields__:
             v = getattr(plan, f)
             if isinstance(v, tuple):
-                nv = tuple(rewrite_plan(x, resolution_ms)
-                           if hasattr(x, "__dataclass_fields__") else x
-                           for x in v)
+                nv = []
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        rx = rewrite_plan(x, resolution_ms)
+                        if rx is None:
+                            return None
+                        nv.append(rx)
+                    else:
+                        nv.append(x)
+                nv = tuple(nv)
                 if nv != v:
                     changes[f] = nv
             elif hasattr(v, "__dataclass_fields__"):
                 nv = rewrite_plan(v, resolution_ms)
+                if nv is None:
+                    return None
                 if nv is not v:
                     changes[f] = nv
         if changes:
@@ -110,4 +130,7 @@ class DownsampledTimeSeriesStore:
         res = select_resolution(self.resolutions, window_ms, step_ms)
         if res is None:
             return None
-        return self.shards_for_resolution(res), rewrite_plan(plan, res)
+        rewritten = rewrite_plan(plan, res)
+        if rewritten is None:
+            return None     # function has no exact ds mapping: use raw
+        return self.shards_for_resolution(res), rewritten

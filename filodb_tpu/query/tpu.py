@@ -27,6 +27,7 @@ number of kernels that get reused across queries.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -390,6 +391,9 @@ class TpuBackend:
     def __init__(self, device: Optional[object] = None):
         self.device = device
         self._tile_cache: Dict = {}
+        # guards cache get/insert/evict against concurrent HTTP query
+        # threads (non-atomic FIFO evict could KeyError, inserts overshoot)
+        self._tile_lock = threading.Lock()
         self.tile_builds = 0    # observability: device tile (re)builds
 
     def periodic_samples(self, series: Sequence[RawSeries],
@@ -473,7 +477,8 @@ class TpuBackend:
             key = tuple(s.snapshot_key for s in series)
         else:
             key = tuple(id(s) for s in series)
-        entry = self._tile_cache.get(key)
+        with self._tile_lock:
+            entry = self._tile_cache.get(key)
         if entry is None:
             prefix = [
                 RawSeries(s.labels, s.ts[:self._prefix_len(s)],
@@ -486,9 +491,10 @@ class TpuBackend:
             prefix_has_nan = any(np.isnan(p.values).any() for p in prefix)
             entry = (tiles, idx, prefix_has_nan,
                      None if use_snap else list(series))
-            if len(self._tile_cache) >= self._TILE_CACHE_MAX:
-                self._tile_cache.pop(next(iter(self._tile_cache)))
-            self._tile_cache[key] = entry
+            with self._tile_lock:
+                while len(self._tile_cache) >= self._TILE_CACHE_MAX:
+                    self._tile_cache.pop(next(iter(self._tile_cache)))
+                self._tile_cache[key] = entry
         return entry
 
     def _try_aligned(self, series, func: str, steps: np.ndarray,

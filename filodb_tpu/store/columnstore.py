@@ -120,8 +120,13 @@ class FlatFileColumnStore(ColumnStore):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        # (dataset, shard) -> {part_key: [file offsets]} lazy ODP index
-        self._chunk_index: Dict[Tuple[str, int], Dict[bytes, List[int]]] = {}
+        # (dataset, shard) -> {part_key: {chunk_id: file offset}} lazy ODP
+        # index; keyed by chunk_id so replayed/re-run appends upsert (last
+        # record wins), matching the reference's Cassandra upsert semantics
+        self._chunk_index: Dict[Tuple[str, int],
+                                Dict[bytes, Dict[int, int]]] = {}
+        # (dataset, shard) sets whose partkeys.log tail has been validated
+        self._pk_validated: set = set()
 
     # -- paths ------------------------------------------------------------
     def _shard_dir(self, dataset: str, shard: int) -> str:
@@ -144,7 +149,10 @@ class FlatFileColumnStore(ColumnStore):
         if not chunks:
             return
         path = self._chunks_path(dataset, shard)
-        idx = self._chunk_index.get((dataset, shard))
+        # building the index first also truncates any torn tail left by a
+        # crash, so appends land at a valid record boundary (otherwise
+        # everything after the torn bytes would be unreachable on replay)
+        idx = self._ensure_chunk_index(dataset, shard)
         with open(path, "ab") as f:
             for c in chunks:
                 off = f.tell()
@@ -157,8 +165,7 @@ class FlatFileColumnStore(ColumnStore):
                 f.write(vec_lens)
                 for v in c.vectors:
                     f.write(v)
-                if idx is not None:
-                    idx.setdefault(part_key, []).append(off)
+                idx.setdefault(part_key, {})[c.id] = off
             f.flush()
             os.fsync(f.fileno())
 
@@ -192,7 +199,11 @@ class FlatFileColumnStore(ColumnStore):
                     vecs.append(b)
                 yield PersistedChunk(pk, cid, nrows, st, en, tuple(vecs))
 
-    def _ensure_chunk_index(self, dataset, shard) -> Dict[bytes, List[int]]:
+    def _ensure_chunk_index(self, dataset, shard
+                            ) -> Dict[bytes, Dict[int, int]]:
+        """Scan the log once, building {pk: {chunk_id: offset}}.  The scan
+        also truncates any torn tail to the last valid record boundary so
+        subsequent appends stay reachable."""
         key = (dataset, shard)
         idx = self._chunk_index.get(key)
         if idx is not None:
@@ -200,13 +211,16 @@ class FlatFileColumnStore(ColumnStore):
         idx = {}
         path = self._chunks_path(dataset, shard)
         if os.path.exists(path):
+            valid_end = 0
             with open(path, "rb") as f:
+                size = os.fstat(f.fileno()).st_size
                 while True:
                     off = f.tell()
                     hdr = f.read(_CHUNK_HDR.size)
                     if len(hdr) < _CHUNK_HDR.size:
                         break
-                    magic, pk_len, ncols, _, *_rest = _CHUNK_HDR.unpack(hdr)
+                    magic, pk_len, ncols, _, cid, *_rest = \
+                        _CHUNK_HDR.unpack(hdr)
                     if magic != _CHUNK_MAGIC:
                         break
                     pk = f.read(pk_len)
@@ -214,29 +228,57 @@ class FlatFileColumnStore(ColumnStore):
                     if len(pk) < pk_len or len(lens_buf) < 4 * ncols:
                         break
                     skip = sum(struct.unpack(f"<{ncols}i", lens_buf))
-                    cur = f.tell()
-                    if cur + skip > os.fstat(f.fileno()).st_size:
+                    if f.tell() + skip > size:
                         break
-                    idx.setdefault(pk, []).append(off)
+                    idx.setdefault(pk, {})[cid] = off
                     f.seek(skip, os.SEEK_CUR)
+                    valid_end = f.tell()
+            if valid_end < os.path.getsize(path):
+                os.truncate(path, valid_end)
         self._chunk_index[key] = idx
         return idx
 
     def read_chunks(self, dataset, shard, part_key, start_ts=0,
                     end_ts=1 << 62) -> List[PersistedChunk]:
         """ODP read path (readRawPartitions, CassandraColumnStore.scala:699).
-        First call per shard builds an in-memory offset index (one scan)."""
+        First call per shard builds an in-memory offset index (one scan).
+        Duplicate appends of the same chunk_id (crash replay, re-run batch
+        jobs) dedupe via the index — last record wins, like a C* upsert."""
         idx = self._ensure_chunk_index(dataset, shard)
-        offs = idx.get(part_key, [])
+        offs = sorted(idx.get(part_key, {}).values())
         out = [c for c in self._iter_chunks(dataset, shard, offs)
                if c.end_ts >= start_ts and c.start_ts <= end_ts]
         out.sort(key=lambda c: c.start_ts)
         return out
 
     # -- partkeys (PartitionKeysTable) -------------------------------------
+    def _validate_pk_log(self, dataset, shard) -> None:
+        """Truncate a torn partkeys.log tail so appends stay reachable."""
+        key = (dataset, shard)
+        if key in self._pk_validated:
+            return
+        path = self._pk_path(dataset, shard)
+        if os.path.exists(path):
+            valid_end = 0
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(_PK_HDR.size)
+                    if len(hdr) < _PK_HDR.size:
+                        break
+                    magic, pk_len, _, _ = _PK_HDR.unpack(hdr)
+                    if magic != _PK_MAGIC:
+                        break
+                    if len(f.read(pk_len)) < pk_len:
+                        break
+                    valid_end = f.tell()
+            if valid_end < os.path.getsize(path):
+                os.truncate(path, valid_end)
+        self._pk_validated.add(key)
+
     def write_part_keys(self, dataset, shard, entries) -> None:
         if not entries:
             return
+        self._validate_pk_log(dataset, shard)
         path = self._pk_path(dataset, shard)
         with open(path, "ab") as f:
             for e in entries:

@@ -155,3 +155,51 @@ def test_regex_and_neq_filters():
     assert len(got) == 4
     got = shard.lookup_partitions([CF.prefix("instance", "inst-")], 0, 1 << 60)
     assert len(got) == 6
+
+
+def test_concurrent_reads_during_flush_no_corruption():
+    """Concurrent query threads sharing the decode cache while flushes
+    publish chunks must never duplicate or drop samples (ADVICE r2 high:
+    unsynchronized decode-cache population)."""
+    import threading
+
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, max_chunk_rows=32)
+    part_holder = {}
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            part = part_holder.get("p")
+            if part is None:
+                continue
+            ts, vals, _ = part.read_full(1)
+            if ts.size:
+                if np.any(np.diff(ts) <= 0):
+                    errors.append("non-monotonic/duplicated timestamps")
+                    return
+                if vals.shape[0] != ts.shape[0]:
+                    errors.append("ts/vals length mismatch")
+                    return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        b = RecordBuilder(DEFAULT_SCHEMAS)
+        t0 = 1_000_000
+        for t in range(600):
+            b.add_sample("gauge", _gauge_labels(0), t0 + t * 1000, float(t))
+        for c in b.containers():
+            shard.ingest(c)
+            part_holder["p"] = next(iter(shard.partitions.values()))
+            shard.flush_all()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    part = part_holder["p"]
+    ts, vals, _ = part.read_full(1)
+    assert ts.size == 600
+    np.testing.assert_array_equal(vals, np.arange(600, dtype=np.float64))
