@@ -123,16 +123,18 @@ def parse_line(line: str, now_ms: Optional[int] = None) -> InfluxRecord:
 
 # -- InputRecord mapping (conversion/InputRecord.scala) ---------------------
 
-def record_to_builder(rec: InfluxRecord, builder: RecordBuilder,
-                      ws: str = "demo", ns: str = "App-0") -> List[str]:
-    """Convert one parsed record into builder samples; returns the schema
-    names used. Shard-key labels default like the dev gateway conf."""
+def input_records(rec: InfluxRecord, ws: str = "demo", ns: str = "App-0"
+                  ) -> List[Tuple[str, Dict[str, str], int, Tuple]]:
+    """Map one parsed influx record to ingest samples:
+    (schema_name, labels, timestamp_ms, values) tuples — the InputRecord
+    schema-mapping logic (conversion/InputRecord.scala), separated from
+    builder insertion so callers can shard-route each sample first."""
     tags = dict(rec.tags)
     ws = tags.pop("_ws_", ws)
     ns = tags.pop("_ns_", ns)
     base = {"_ws_": ws, "_ns_": ns, **tags}
     fields = rec.fields
-    used: List[str] = []
+    out: List[Tuple[str, Dict[str, str], int, Tuple]] = []
     le_fields = {k: v for k, v in fields.items()
                  if k not in ("sum", "count", "min", "max")
                  and _is_le(k)}
@@ -143,33 +145,38 @@ def record_to_builder(rec: InfluxRecord, builder: RecordBuilder,
             float("inf") if k in ("+Inf", "inf") else float(k)
             for k in les))
         counts = np.array([le_fields[k] for k in les], dtype=np.float64)
-        builder.add_sample("prom-histogram",
-                           {**base, "_metric_": rec.measurement},
-                           rec.timestamp_ms, fields["sum"],
-                           fields["count"], (scheme, counts))
-        used.append("prom-histogram")
-        return used
+        out.append(("prom-histogram",
+                    {**base, "_metric_": rec.measurement}, rec.timestamp_ms,
+                    (fields["sum"], fields["count"], (scheme, counts))))
+        return out
     if "counter" in fields:
-        builder.add_sample("prom-counter",
-                           {**base, "_metric_": rec.measurement},
-                           rec.timestamp_ms, fields["counter"])
-        used.append("prom-counter")
-        return used
+        out.append(("prom-counter", {**base, "_metric_": rec.measurement},
+                    rec.timestamp_ms, (fields["counter"],)))
+        return out
     single = None
     for name in ("gauge", "value"):
         if name in fields:
             single = fields[name]
             break
     if single is not None:
-        builder.add_sample("gauge", {**base, "_metric_": rec.measurement},
-                           rec.timestamp_ms, single)
-        used.append("gauge")
-        return used
+        out.append(("gauge", {**base, "_metric_": rec.measurement},
+                    rec.timestamp_ms, (single,)))
+        return out
     for fname, fval in fields.items():
         metric = f"{rec.measurement}_{fname}"
-        builder.add_sample("gauge", {**base, "_metric_": metric},
-                           rec.timestamp_ms, fval)
-        used.append("gauge")
+        out.append(("gauge", {**base, "_metric_": metric},
+                    rec.timestamp_ms, (fval,)))
+    return out
+
+
+def record_to_builder(rec: InfluxRecord, builder: RecordBuilder,
+                      ws: str = "demo", ns: str = "App-0") -> List[str]:
+    """Convert one parsed record into builder samples; returns the schema
+    names used. Shard-key labels default like the dev gateway conf."""
+    used: List[str] = []
+    for schema_name, labels, ts, values in input_records(rec, ws, ns):
+        builder.add_sample(schema_name, labels, ts, *values)
+        used.append(schema_name)
     return used
 
 

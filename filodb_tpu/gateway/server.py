@@ -1,0 +1,123 @@
+"""Gateway TCP server: influx line protocol in, per-shard streams out.
+
+(Reference: gateway/src/main/scala/filodb/gateway/GatewayServer.scala —
+Netty TCP server :60 parsing influx lines, computing shardKeyHash/
+partKeyHash and routing via shardMapper.ingestionShard :120,164, batching
+per-shard RecordBuilders, publishing containers to Kafka via
+KafkaContainerSink.  Here "Kafka" is the per-shard LogIngestionStream and
+the server is a stdlib ThreadingTCPServer — the ingest edge is host-side
+I/O, not device work.)
+
+Wire protocol: newline-delimited influx lines; `#`-prefixed lines are
+comments.  Batches are published per shard every ``batch_lines`` lines or
+when a connection closes, preserving per-connection ordering per shard.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Dict, List, Optional
+
+from filodb_tpu.core.record import RecordBuilder, ingestion_shard
+from filodb_tpu.core.record import PartKey
+from filodb_tpu.core.schemas import PartitionSchema, Schemas
+from filodb_tpu.gateway.influx import input_records, parse_line
+from filodb_tpu.ingest.stream import IngestionStream
+
+
+class GatewayServer:
+    """TCP ingest edge, one instance per gateway process."""
+
+    def __init__(self, streams: Dict[int, IngestionStream], schemas: Schemas,
+                 num_shards: int, spread: int = 1, port: int = 0,
+                 host: str = "127.0.0.1", batch_lines: int = 256,
+                 ws: str = "demo", ns: str = "App-0"):
+        self.streams = streams
+        self.schemas = schemas
+        self.num_shards = num_shards
+        self.spread = spread
+        self.batch_lines = batch_lines
+        self.ws, self.ns = ws, ns
+        self.part_schema = PartitionSchema()
+        self.lines_ingested = 0
+        self.lines_rejected = 0
+        gateway = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                builders: Dict[int, RecordBuilder] = {}
+                pending = 0
+                for raw in self.rfile:
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    if gateway._route_line(line, builders):
+                        pending += 1
+                    if pending >= gateway.batch_lines:
+                        gateway._publish(builders)
+                        pending = 0
+                gateway._publish(builders)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing -----------------------------------------------------------
+    def _route_line(self, line: str, builders: Dict[int, RecordBuilder]
+                    ) -> bool:
+        """Parse one line, append each resulting sample to its shard's
+        builder (GatewayServer.scala:120 shardKeyHash->ingestionShard)."""
+        try:
+            rec = parse_line(line)
+            samples = input_records(rec, self.ws, self.ns)
+        except ValueError:
+            self.lines_rejected += 1
+            return False
+        for schema_name, labels, ts, values in samples:
+            schema = self.schemas.by_name(schema_name)
+            pk = PartKey.make(schema, labels)
+            shard = ingestion_shard(pk.shard_key_hash(self.part_schema),
+                                    pk.part_hash(), self.spread,
+                                    self.num_shards)
+            b = builders.setdefault(shard, RecordBuilder(self.schemas))
+            b.add_sample(schema_name, labels, ts, *values)
+        self.lines_ingested += 1
+        return True
+
+    def _publish(self, builders: Dict[int, RecordBuilder]) -> None:
+        """Flush per-shard builders into their streams (KafkaContainerSink).
+        """
+        for shard, b in builders.items():
+            stream = self.streams.get(shard)
+            if stream is None:
+                continue
+            for cont in b.containers():
+                stream.append(cont)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "GatewayServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="gateway-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+
+def send_lines(host: str, port: int, lines: List[str],
+               timeout: float = 10.0) -> None:
+    """Small client for tests/tools: push influx lines to a gateway."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        payload = ("\n".join(lines) + "\n").encode()
+        s.sendall(payload)

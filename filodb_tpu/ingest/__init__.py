@@ -1,0 +1,15 @@
+"""Streaming ingestion: stream sources, per-shard drivers, recovery.
+
+(Reference packages: kafka/ + coordinator IngestionActor/IngestionStream.)
+"""
+
+from filodb_tpu.ingest.driver import IngestionDriver, start_ingestion
+from filodb_tpu.ingest.stream import (IngestionStream, LogIngestionStream,
+                                      MemoryIngestionStream, SomeData,
+                                      decode_container, encode_container)
+
+__all__ = [
+    "IngestionDriver", "start_ingestion", "IngestionStream",
+    "LogIngestionStream", "MemoryIngestionStream", "SomeData",
+    "decode_container", "encode_container",
+]

@@ -1,0 +1,159 @@
+"""Per-shard ingestion driver: checkpoint recovery then steady-state
+ingest with interleaved group flushes.
+
+(Reference: coordinator/IngestionActor.scala — ``startIngestion`` :174
+reads the checkpoint watermark, ``doRecovery`` :297 replays the stream
+from it publishing RecoveryInProgress events, ``normalIngestion`` :240
+drives TimeSeriesShard.startIngestion; flush tasks are interleaved with
+ingest on the shard's single ingest thread, TimeSeriesShard.scala:897.)
+
+The TPU build keeps the same protocol minus the actor machinery: one
+Python thread per shard runs
+
+    bootstrap (index + checkpoints from the ColumnStore, done by caller)
+      -> recovery: replay stream from min(checkpoints) to the stream end
+         observed at startup, shard status RECOVERY(progress%)
+         (rows already flushed are dropped by the partitions' OOO guard)
+      -> steady state: poll the stream; every ``flush_every_records``
+         offsets (or ``flush_interval_s`` wall clock) flush the next
+         flush group round-robin, checkpointing the last ingested offset.
+
+Flush rotation mirrors the reference's groups-per-shard scheduling
+(doc/ingestion.md "Recovery and Persistence"): each group checkpoint =
+"all my partitions' rows at/below this offset are encoded+persisted", so
+the replay watermark is min over groups.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.ingest.stream import IngestionStream
+from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+
+
+class IngestionDriver:
+    """Drives one shard from one stream (IngestionActor + shard thread)."""
+
+    def __init__(self, shard: TimeSeriesShard, stream: IngestionStream,
+                 mapper: Optional[ShardMapper] = None,
+                 flush_every_records: Optional[int] = None,
+                 flush_interval_s: float = 1.0,
+                 poll_interval_s: float = 0.02,
+                 on_event: Optional[Callable] = None):
+        self.shard = shard
+        self.stream = stream
+        self.mapper = mapper
+        self.flush_every_records = flush_every_records
+        self.flush_interval_s = flush_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.on_event = on_event or (lambda *a: None)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_group = 0
+        self._last_flush_t = 0.0
+        self._records_since_flush = 0
+        self.next_offset = 0          # next stream offset to ingest
+        self.recovered_to = -1        # end of the recovery replay window
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "IngestionDriver":
+        self._thread = threading.Thread(
+            target=self._run, name=f"ingest-shard-{self.shard.shard_num}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if flush and self.next_offset > 0:
+            # final flush of all groups at the last ingested offset, so a
+            # clean shutdown restarts with an up-to-date watermark
+            self.shard.flush_all(offset=self.next_offset - 1)
+
+    # -- protocol ----------------------------------------------------------
+    def _set_status(self, status: ShardStatus, progress: int = 0) -> None:
+        if self.mapper is not None:
+            self.mapper.update(self.shard.shard_num, status,
+                               progress_pct=progress)
+        self.on_event(self.shard.shard_num, status, progress)
+
+    def _run(self) -> None:
+        try:
+            self._last_flush_t = time.monotonic()
+            self._recover()
+            self._set_status(ShardStatus.ACTIVE)
+            self._last_flush_t = time.monotonic()
+            while not self._stop.is_set():
+                if not self._ingest_available():
+                    self._maybe_flush(force_time_check=True)
+                    self._stop.wait(self.poll_interval_s)
+        except Exception:               # pragma: no cover - defensive
+            self._set_status(ShardStatus.ERROR)
+            raise
+
+    def _recover(self) -> None:
+        """Replay from the checkpoint watermark to the stream end observed
+        at startup (IngestionActor.doRecovery :297).  The OOO guard drops
+        rows at/below each partition's persisted end time, so replaying
+        below per-group checkpoints is idempotent."""
+        watermark = self.shard.recovery_watermark()
+        # groups that never flushed have no checkpoint -> replay everything
+        start = watermark + 1 if watermark >= 0 else 0
+        end = self.stream.end_offset()          # recovery target
+        self.next_offset = start
+        self.recovered_to = end
+        if start >= end:
+            return
+        self._set_status(ShardStatus.RECOVERY, 0)
+        while self.next_offset < end and not self._stop.is_set():
+            if not self._ingest_available(limit=end - self.next_offset):
+                break                            # stream shrank (shouldn't)
+            done = self.next_offset - start
+            pct = int(100 * done / max(1, end - start))
+            self._set_status(ShardStatus.RECOVERY, min(pct, 99))
+
+    def _ingest_available(self, limit: int = 64) -> bool:
+        """Poll + ingest one batch; returns True if anything was read."""
+        batch = self.stream.read(self.next_offset, max_records=limit)
+        if not batch:
+            return False
+        for sd in batch:
+            self.shard.ingest(sd.container, sd.offset)
+            self.next_offset = sd.offset + 1
+            self._records_since_flush += 1
+            self._maybe_flush()
+        return True
+
+    def _maybe_flush(self, force_time_check: bool = False) -> None:
+        due = False
+        if self.flush_every_records is not None:
+            due = self._records_since_flush >= self.flush_every_records
+        if not due:
+            now = time.monotonic()
+            if now - self._last_flush_t >= self.flush_interval_s:
+                due = True
+        if not due or self.next_offset == 0:
+            return
+        group = self._next_group
+        self._next_group = (self._next_group + 1) % self.shard.num_groups
+        self.shard.flush_group(group, offset=self.next_offset - 1)
+        self._records_since_flush = 0
+        self._last_flush_t = time.monotonic()
+
+
+def start_ingestion(shards: List[TimeSeriesShard],
+                    streams: List[IngestionStream],
+                    mapper: Optional[ShardMapper] = None,
+                    **kw) -> List[IngestionDriver]:
+    """Start one driver per (shard, stream) pair."""
+    drivers = [IngestionDriver(sh, st, mapper, **kw)
+               for sh, st in zip(shards, streams)]
+    for d in drivers:
+        d.start()
+    return drivers

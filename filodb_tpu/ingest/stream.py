@@ -1,0 +1,273 @@
+"""Ingestion streams: pull-based, offset-carrying sample sources.
+
+The reference's ingestion source boundary is IngestionStream
+(coordinator/IngestionStream.scala:14,43) with the production impl bound
+1 shard <-> 1 Kafka partition (kafka/KafkaIngestionStream.scala:26; ``get``
+:81 returns an Observable[SomeData(RecordContainer, offset)] seeked to the
+recovery offset).  Here the same contract is a poll API over monotonic
+record ordinals:
+
+  * ``SomeData`` = one RecordContainer + the offset it was published at.
+  * ``IngestionStream.read(from_offset, max_records)`` returns whatever is
+    available (possibly empty) — the ingestion driver polls it, exactly
+    like a Kafka consumer poll loop.
+  * ``LogIngestionStream`` is the durable Kafka-partition equivalent: an
+    append-only framed file per shard.  The gateway (producer side) appends
+    containers; the server (consumer side) tails the file across process
+    boundaries, so a killed server replays from its checkpoint watermark.
+  * ``MemoryIngestionStream`` is the in-process test stream (the
+    reference's sources/CsvStream analogue).
+
+Readers never truncate: a torn tail may be a writer mid-append (the two
+sides are different processes); the reader simply waits for the record to
+complete.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from filodb_tpu.core.record import PartKey, RecordContainer
+from filodb_tpu.core.schemas import ColumnType, Schemas
+from filodb_tpu.memory.histogram import _decode_scheme, _encode_scheme
+
+_REC_MAGIC = 0xF10D
+# record header: magic u16, schema_name_len u16, nrows u32, payload_len u32
+_REC_HDR = struct.Struct("<HHII")
+
+
+@dataclass(frozen=True)
+class SomeData:
+    """One published batch (IngestionStream.scala SomeData)."""
+    container: RecordContainer
+    offset: int
+
+
+class IngestionStream:
+    """Source abstraction (IngestionStream.scala:14): a sequence of
+    RecordContainers with monotonically increasing offsets."""
+
+    def read(self, from_offset: int, max_records: int = 64
+             ) -> List[SomeData]:
+        """Poll: return up to ``max_records`` batches at/after
+        ``from_offset`` that are available now (may be empty)."""
+        raise NotImplementedError
+
+    def end_offset(self) -> int:
+        """Offset one past the last published record (Kafka endOffset)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryIngestionStream(IngestionStream):
+    """In-process stream for tests and embedded producers."""
+
+    def __init__(self):
+        self._records: List[RecordContainer] = []
+        self._lock = threading.Lock()
+
+    def append(self, container: RecordContainer) -> int:
+        with self._lock:
+            self._records.append(container)
+            return len(self._records) - 1
+
+    def read(self, from_offset: int, max_records: int = 64
+             ) -> List[SomeData]:
+        with self._lock:
+            hi = min(len(self._records), from_offset + max_records)
+            return [SomeData(self._records[i], i)
+                    for i in range(max(0, from_offset), hi)]
+
+    def end_offset(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+# ---------------------------------------------------------------------------
+# Container wire format (the RecordContainer serde — the Kafka payload
+# analogue, kafka/RecordContainerSerde)
+# ---------------------------------------------------------------------------
+
+def _encode_values(schema, columns: Sequence[Sequence], row: int) -> bytes:
+    out = bytearray()
+    for col, colvals in zip(schema.data_columns, columns):
+        v = colvals[row]
+        if col.col_type == ColumnType.HISTOGRAM:
+            scheme, counts = v
+            counts = np.asarray(counts, dtype="<f8")
+            sb = _encode_scheme(scheme)
+            out.extend(struct.pack("<HH", len(sb), counts.size))
+            out.extend(sb)
+            out.extend(counts.tobytes())
+        else:
+            out.extend(struct.pack("<d", float(v)))
+    return bytes(out)
+
+
+def _decode_values(schema, buf: bytes, off: int) -> Tuple[Tuple, int]:
+    vals = []
+    for col in schema.data_columns:
+        if col.col_type == ColumnType.HISTOGRAM:
+            sb_len, n = struct.unpack_from("<HH", buf, off)
+            off += 4
+            scheme, _ = _decode_scheme(buf, off)
+            off += sb_len
+            counts = np.frombuffer(buf, dtype="<f8", count=n, offset=off)
+            off += 8 * n
+            vals.append((scheme, counts))
+        else:
+            (v,) = struct.unpack_from("<d", buf, off)
+            off += 8
+            vals.append(v)
+    return tuple(vals), off
+
+
+def encode_container(container: RecordContainer) -> bytes:
+    """Serialize one RecordContainer to a framed record."""
+    schema = container.schema
+    name = schema.name.encode()
+    payload = bytearray()
+    for i in range(len(container)):
+        pk = container.part_keys[i].to_bytes()
+        payload.extend(struct.pack("<H", len(pk)))
+        payload.extend(pk)
+        payload.extend(struct.pack("<q", container.timestamps[i]))
+        payload.extend(_encode_values(schema, container.columns, i))
+    return (_REC_HDR.pack(_REC_MAGIC, len(name), len(container),
+                          len(payload)) + name + bytes(payload))
+
+
+def decode_container(buf: bytes, off: int, schemas: Schemas
+                     ) -> Tuple[Optional[RecordContainer], int]:
+    """Decode one framed record at ``off``; returns (container, next_off)
+    or (None, off) when the record is incomplete (torn / mid-write)."""
+    if off + _REC_HDR.size > len(buf):
+        return None, off
+    magic, name_len, nrows, payload_len = _REC_HDR.unpack_from(buf, off)
+    if magic != _REC_MAGIC:
+        raise ValueError(f"bad stream record magic at {off}")
+    end = off + _REC_HDR.size + name_len + payload_len
+    if end > len(buf):
+        return None, off
+    p = off + _REC_HDR.size
+    name = buf[p:p + name_len].decode()
+    p += name_len
+    schema = schemas.by_name(name)
+    cont = RecordContainer(schema)
+    for _ in range(nrows):
+        (pk_len,) = struct.unpack_from("<H", buf, p)
+        p += 2
+        pk = PartKey.from_bytes(buf[p:p + pk_len])
+        p += pk_len
+        (ts,) = struct.unpack_from("<q", buf, p)
+        p += 8
+        vals, p = _decode_values(schema, buf, p)
+        cont.add(pk, ts, *vals)
+    return cont, end
+
+
+class LogIngestionStream(IngestionStream):
+    """Durable file-backed stream: one append-only framed log per shard —
+    the Kafka-partition analogue (1 shard <-> 1 log, KafkaIngestionStream).
+
+    Producer side uses ``append``; consumer side polls ``read``.  The two
+    may be different processes: the reader tails the file, stopping at any
+    incomplete tail record until the writer finishes it."""
+
+    def __init__(self, path: str, schemas: Schemas):
+        self.path = path
+        self.schemas = schemas
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._write_f = None
+        self._lock = threading.Lock()
+        # reader state: byte positions of each complete record
+        self._positions: List[int] = []
+        self._valid_end = 0
+
+    # -- producer side ----------------------------------------------------
+    def append(self, container: RecordContainer, fsync: bool = True) -> int:
+        """Publish one container; returns its offset (ordinal).  One writer
+        per shard log (the shard<->partition ownership invariant); on
+        takeover, a torn tail left by a crashed writer is truncated so the
+        new append lands on a record boundary."""
+        data = encode_container(container)
+        with self._lock:
+            if self._write_f is None:
+                self._refresh_locked()
+                if os.path.exists(self.path) and \
+                        os.path.getsize(self.path) > self._valid_end:
+                    os.truncate(self.path, self._valid_end)
+                self._write_f = open(self.path, "ab")
+            off = len(self._positions)
+            self._write_f.write(data)
+            self._write_f.flush()
+            if fsync:
+                os.fsync(self._write_f.fileno())
+            self._positions.append(self._valid_end)
+            self._valid_end += len(data)
+            return off
+
+    # -- consumer side ----------------------------------------------------
+    def _refresh_locked(self) -> int:
+        """Extend the position index over newly appended bytes; returns the
+        current record count."""
+        if not os.path.exists(self.path):
+            return 0
+        size = os.path.getsize(self.path)
+        if size <= self._valid_end:
+            return len(self._positions)
+        with open(self.path, "rb") as f:
+            f.seek(self._valid_end)
+            buf = f.read(size - self._valid_end)
+        p = 0
+        while p + _REC_HDR.size <= len(buf):
+            magic, name_len, _, payload_len = _REC_HDR.unpack_from(buf, p)
+            if magic != _REC_MAGIC:
+                # corrupt bytes mid-log: stop indexing here permanently
+                break
+            end = p + _REC_HDR.size + name_len + payload_len
+            if end > len(buf):
+                break                      # torn tail: writer mid-append
+            self._positions.append(self._valid_end + p)
+            p = end
+        self._valid_end += p
+        return len(self._positions)
+
+    def read(self, from_offset: int, max_records: int = 64
+             ) -> List[SomeData]:
+        with self._lock:
+            n = self._refresh_locked()
+            lo = max(0, from_offset)
+            hi = min(n, lo + max_records)
+            if lo >= hi:
+                return []
+            positions = self._positions[lo:hi]
+            valid_end = self._valid_end
+        out: List[SomeData] = []
+        with open(self.path, "rb") as f:
+            f.seek(positions[0])
+            buf = f.read(valid_end - positions[0])
+        for i, pos in enumerate(positions):
+            cont, _ = decode_container(buf, pos - positions[0], self.schemas)
+            if cont is None:
+                break
+            out.append(SomeData(cont, lo + i))
+        return out
+
+    def end_offset(self) -> int:
+        with self._lock:
+            return self._refresh_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._write_f is not None:
+                self._write_f.close()
+                self._write_f = None

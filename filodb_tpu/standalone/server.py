@@ -38,6 +38,17 @@ DEFAULTS = {
     # chunk/partkey/checkpoint persistence root; None = memory-only
     # (conf/timeseries-filodb-server.conf store path equivalent)
     "data-dir": None,
+    # streaming ingestion: per-shard durable stream logs (the Kafka
+    # partition analogue, conf/timeseries-dev-source.conf sourceconfig);
+    # None = no streaming ingestion (direct/test ingest only)
+    "stream-dir": None,
+    # influx line-protocol ingest edge (GatewayServer.scala); None = off,
+    # 0 = ephemeral port
+    "gateway-port": None,
+    # flush cadence: one flush group every interval, rotating round-robin
+    # (flush-interval in the reference source config)
+    "flush-interval-s": 2.0,
+    "flush-every-records": None,
 }
 
 
@@ -55,6 +66,9 @@ class FiloServer:
         self.mapper = ShardMapper(self.config["num-shards"])
         self.backend = backend
         self.http: Optional[FiloHttpServer] = None
+        self.streams: Dict[int, object] = {}
+        self.drivers: list = []
+        self.gateway = None
 
     def start(self) -> "FiloServer":
         n = self.config["num-shards"]
@@ -64,8 +78,10 @@ class FiloServer:
                              max_chunk_rows=self.config["max-chunks-size"],
                              bootstrap=self.store.column_store is not None)
         assign_shards_evenly(self.mapper, [self.config["node-id"]])
-        for shard in range(n):
-            self.mapper.activate(shard)
+        streaming = bool(self.config.get("stream-dir"))
+        if not streaming:
+            for shard in range(n):
+                self.mapper.activate(shard)
         if self.backend is None:
             try:
                 from filodb_tpu.query.tpu import TpuBackend
@@ -89,7 +105,36 @@ class FiloServer:
             spread=int(self.config.get("default-spread", 1)),
             port=self.config["port"])
         self.http.start()
+        if streaming:
+            self._start_ingestion()
         return self
+
+    def _start_ingestion(self) -> None:
+        """Streaming path: per-shard durable stream logs + ingestion
+        drivers (recovery -> active), plus the optional influx gateway
+        (NewFiloServerMain.start: memstore, ingestion, http)."""
+        import os
+
+        from filodb_tpu.ingest import IngestionDriver, LogIngestionStream
+        stream_dir = self.config["stream-dir"]
+        n = self.config["num-shards"]
+        for shard in range(n):
+            path = os.path.join(stream_dir, f"shard={shard}", "stream.log")
+            self.streams[shard] = LogIngestionStream(path, DEFAULT_SCHEMAS)
+        for shard in range(n):
+            drv = IngestionDriver(
+                self.store.get_shard(self.ref, shard), self.streams[shard],
+                mapper=self.mapper,
+                flush_every_records=self.config.get("flush-every-records"),
+                flush_interval_s=float(self.config.get("flush-interval-s",
+                                                       2.0)))
+            self.drivers.append(drv.start())
+        if self.config.get("gateway-port") is not None:
+            from filodb_tpu.gateway.server import GatewayServer
+            self.gateway = GatewayServer(
+                self.streams, DEFAULT_SCHEMAS, num_shards=n,
+                spread=int(self.config.get("default-spread", 1)),
+                port=int(self.config["gateway-port"])).start()
 
     def seed_dev_data(self, n_samples: int = 360, n_instances: int = 4,
                       start_ms: Optional[int] = None) -> int:
@@ -113,6 +158,12 @@ class FiloServer:
         return rows
 
     def stop(self) -> None:
+        if self.gateway is not None:
+            self.gateway.stop()
+        for drv in self.drivers:
+            drv.stop()
+        for stream in self.streams.values():
+            stream.close()
         if self.http:
             self.http.stop()
 
@@ -127,6 +178,9 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int)
     p.add_argument("--num-shards", type=int)
     p.add_argument("--dataset")
+    p.add_argument("--data-dir")
+    p.add_argument("--stream-dir")
+    p.add_argument("--gateway-port", type=int)
     p.add_argument("--seed-dev-data", action="store_true",
                    help="generate dev series on startup")
     args = p.parse_args(argv)
@@ -134,7 +188,8 @@ def main(argv=None) -> int:
     if args.config:
         with open(args.config) as f:
             config.update(json.load(f))
-    for k in ("port", "num_shards", "dataset"):
+    for k in ("port", "num_shards", "dataset", "data_dir", "stream_dir",
+              "gateway_port"):
         v = getattr(args, k)
         if v is not None:
             config[k.replace("_", "-")] = v
@@ -142,6 +197,9 @@ def main(argv=None) -> int:
     if args.seed_dev_data:
         rows = server.seed_dev_data()
         print(f"seeded {rows} dev samples", file=sys.stderr)
+    # machine-readable startup line (test harness / dev scripts read this)
+    gw = server.gateway.port if server.gateway is not None else None
+    print(json.dumps({"port": server.port, "gateway_port": gw}), flush=True)
     print(f"filodb-tpu server listening on :{server.port}", file=sys.stderr)
     try:
         while True:

@@ -1,0 +1,120 @@
+"""IngestionStream tests: container serde round-trip, log tailing across
+"process" boundaries (separate stream objects over one file), torn tails.
+
+(Parity model: kafka/src/test SourceSinkSuite + RecordContainerSerde;
+IngestionStream.scala contract.)"""
+
+import os
+
+import numpy as np
+
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.ingest import (LogIngestionStream, MemoryIngestionStream,
+                               decode_container, encode_container)
+from filodb_tpu.memory.histogram import CustomBuckets
+
+
+def _containers(n_samples=10, t0=1_000_000):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for i in range(n_samples):
+        b.add_sample("gauge",
+                     {"_metric_": "heap_usage", "_ws_": "demo",
+                      "_ns_": "App-0", "instance": "i0"},
+                     t0 + i * 1000, float(i))
+        b.add_sample("prom-counter",
+                     {"_metric_": "reqs_total", "_ws_": "demo",
+                      "_ns_": "App-0", "instance": "i0"},
+                     t0 + i * 1000, float(i * 10))
+    return b.containers()
+
+
+def test_container_serde_roundtrip():
+    for cont in _containers():
+        buf = encode_container(cont)
+        got, end = decode_container(buf, 0, DEFAULT_SCHEMAS)
+        assert end == len(buf)
+        assert got.schema.name == cont.schema.name
+        assert got.timestamps == cont.timestamps
+        assert got.part_keys == cont.part_keys
+        for a, b in zip(got.columns, cont.columns):
+            np.testing.assert_allclose(a, b)
+
+
+def test_container_serde_histogram():
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    scheme = CustomBuckets((1.0, 5.0, float("inf")))
+    b.add_sample("prom-histogram",
+                 {"_metric_": "lat", "_ws_": "demo", "_ns_": "App-0"},
+                 1_000, 12.5, 3.0, (scheme, np.array([1.0, 2.0, 3.0])))
+    (cont,) = b.containers()
+    got, _ = decode_container(encode_container(cont), 0, DEFAULT_SCHEMAS)
+    s, c = got.columns[2][0]
+    assert s == scheme
+    np.testing.assert_allclose(c, [1.0, 2.0, 3.0])
+    assert got.columns[0][0] == 12.5 and got.columns[1][0] == 3.0
+
+
+def test_memory_stream_poll():
+    st = MemoryIngestionStream()
+    conts = _containers()
+    for c in conts:
+        st.append(c)
+    assert st.end_offset() == len(conts)
+    batch = st.read(0)
+    assert [sd.offset for sd in batch] == list(range(len(conts)))
+    assert st.read(len(conts)) == []
+    assert st.read(1, max_records=1)[0].offset == 1
+
+
+def test_log_stream_cross_process_tail(tmp_path):
+    """Producer and consumer as separate stream objects over one file —
+    the gateway-process/server-process split."""
+    path = str(tmp_path / "shard=0" / "stream.log")
+    producer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    consumer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    conts = _containers()
+    assert producer.append(conts[0]) == 0
+    batch = consumer.read(0)
+    assert len(batch) == 1 and batch[0].offset == 0
+    assert batch[0].container.timestamps == conts[0].timestamps
+    # consumer sees later appends without reopening
+    assert producer.append(conts[1]) == 1
+    batch = consumer.read(1)
+    assert len(batch) == 1 and batch[0].offset == 1
+    assert consumer.end_offset() == 2
+
+
+def test_log_stream_replay_from_offset(tmp_path):
+    path = str(tmp_path / "stream.log")
+    producer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    conts = _containers(n_samples=4)
+    for c in conts:
+        producer.append(c)
+    # "restarted" consumer replays from offset 1
+    consumer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    batch = consumer.read(1, max_records=100)
+    assert [sd.offset for sd in batch] == [1]
+    got, want = batch[0].container, conts[1]
+    assert got.timestamps == want.timestamps
+
+
+def test_log_stream_torn_tail_not_consumed(tmp_path):
+    """A torn (mid-write) tail record is invisible to readers and
+    truncated by the next writer takeover."""
+    path = str(tmp_path / "stream.log")
+    producer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    conts = _containers(n_samples=3)
+    producer.append(conts[0])
+    producer.append(conts[1])
+    producer.close()
+    with open(path, "ab") as f:       # crash mid-append
+        f.write(encode_container(conts[0])[:11])
+    consumer = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    assert consumer.end_offset() == 2          # torn tail ignored
+    # new writer truncates the torn tail, then appends cleanly
+    producer2 = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    assert producer2.append(conts[1]) == 2
+    consumer2 = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    assert consumer2.end_offset() == 3
+    assert [sd.offset for sd in consumer2.read(0, 100)] == [0, 1, 2]
