@@ -150,11 +150,13 @@ def test_recovery_idempotent_replay_below_group_checkpoints(tmp_path):
     assert _wait(lambda: drv.next_offset == 10)
     drv.stop()
 
-    # every series has each timestamp exactly once
+    # every series has each timestamp exactly once.  Go through the real
+    # read path (lookup_partitions) so ODP shells page their persisted
+    # history back in — a shell whose replayed rows were all beyond its
+    # persisted end stays unpaged until a query touches it.
     total_expected = 10 * 20  # all batches
-    total = sum(p.ingested + (p.persisted_chunks and 0)
-                for p in shard2.partitions.values())
-    parts = list(shard2.partitions.values())
+    parts = shard2.lookup_partitions([], 0, 2**62)
+    assert len(parts) == 2
     n_rows = 0
     for p in parts:
         ts, _, _ = p.read_full(1)
