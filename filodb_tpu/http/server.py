@@ -42,12 +42,16 @@ class FiloHttpServer:
                  shard_mapper: Optional[object] = None,
                  mesh_executor: Optional[object] = None,
                  spread: int = 1,   # MUST match ingest spread (default-spread)
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ds_store_by_dataset: Optional[Dict[str, object]] = None,
+                 raw_retention_ms: int = 0):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
         self.mesh_executor = mesh_executor
         self.spread = spread
+        self.ds_store_by_dataset = ds_store_by_dataset or {}
+        self.raw_retention_ms = raw_retention_ms
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -114,7 +118,9 @@ class FiloHttpServer:
         engine = QueryPlanner(shards, backend=self.backend,
                               shard_mapper=self.shard_mapper,
                               mesh_executor=self.mesh_executor,
-                              spread=self.spread)
+                              spread=self.spread,
+                              ds_store=self.ds_store_by_dataset.get(ds),
+                              raw_retention_ms=self.raw_retention_ms)
         if rest == "query_range":
             return self._query_range(engine, qs)
         if rest == "query":

@@ -900,31 +900,51 @@ class QueryEngine:
 
 
 def lp_replace_range(plan, start_ms: int, step_ms: int, end_ms: int):
-    """Rewrite a plan's evaluation range (used for subqueries)."""
+    """Rewrite a plan's evaluation range (used for subqueries and the
+    raw/downsample tier split)."""
     import dataclasses
     if isinstance(plan, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing)):
-        raw = dataclasses.replace(plan.raw,
-                                  start_ms=start_ms - _plan_window(plan),
-                                  end_ms=end_ms)
+        # raw fetch bounds mirror the parser: the window AND the offset
+        # shift what data a step can touch (promql/parser.py selector
+        # materialization)
+        raw = dataclasses.replace(
+            plan.raw,
+            start_ms=start_ms - _plan_window(plan) - plan.offset_ms,
+            end_ms=end_ms - plan.offset_ms if plan.offset_ms else end_ms)
         return dataclasses.replace(plan, raw=raw, start_ms=start_ms,
                                    step_ms=step_ms, end_ms=end_ms)
     if isinstance(plan, (lp.Aggregate, lp.ApplyInstantFunction,
                          lp.ApplyMiscellaneousFunction, lp.ApplySortFunction,
-                         lp.ApplyLimitFunction)):
-        import dataclasses
-        return dataclasses.replace(
-            plan, inner=lp_replace_range(plan.inner, start_ms, step_ms,
-                                         end_ms))
+                         lp.ApplyLimitFunction, lp.ScalarVaryingDoublePlan,
+                         lp.ApplyAbsentFunction)):
+        changes = {"inner": lp_replace_range(plan.inner, start_ms, step_ms,
+                                             end_ms)}
+        if isinstance(plan, lp.ApplyAbsentFunction):
+            changes.update(start_ms=start_ms, step_ms=step_ms, end_ms=end_ms)
+        return dataclasses.replace(plan, **changes)
     if isinstance(plan, lp.BinaryJoin):
-        import dataclasses
         return dataclasses.replace(
             plan,
             lhs=lp_replace_range(plan.lhs, start_ms, step_ms, end_ms),
             rhs=lp_replace_range(plan.rhs, start_ms, step_ms, end_ms))
     if isinstance(plan, lp.ScalarVectorBinaryOperation):
-        import dataclasses
         return dataclasses.replace(
-            plan, vector=lp_replace_range(plan.vector, start_ms, step_ms,
+            plan,
+            scalar=lp_replace_range(plan.scalar, start_ms, step_ms, end_ms),
+            vector=lp_replace_range(plan.vector, start_ms, step_ms, end_ms))
+    if isinstance(plan, (lp.ScalarTimeBasedPlan, lp.ScalarFixedDoublePlan)):
+        return dataclasses.replace(plan, start_ms=start_ms, step_ms=step_ms,
+                                   end_ms=end_ms)
+    if isinstance(plan, lp.ScalarBinaryOperation):
+        def _side(x):
+            return x if isinstance(x, (int, float)) else \
+                lp_replace_range(x, start_ms, step_ms, end_ms)
+        return dataclasses.replace(plan, lhs=_side(plan.lhs),
+                                   rhs=_side(plan.rhs), start_ms=start_ms,
+                                   step_ms=step_ms, end_ms=end_ms)
+    if isinstance(plan, lp.VectorPlan):
+        return dataclasses.replace(
+            plan, scalar=lp_replace_range(plan.scalar, start_ms, step_ms,
                                           end_ms))
     return plan
 

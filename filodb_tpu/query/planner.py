@@ -73,6 +73,121 @@ class PlannerParams:
     series_limit: int = 0
 
 
+def plan_range(plan) -> Optional[Tuple[int, int, int, int, int]]:
+    """(start_ms, step_ms, end_ms, max_window_ms, max_lookback_ms) of the
+    evaluation grid shared by all periodic nodes, or None when the plan has
+    no periodic node or the nodes disagree (e.g. nested subquery grids).
+    max_lookback additionally includes offsets — the earliest data instant
+    any step can touch is ``start - max_lookback``."""
+    grids: List[Tuple[int, int, int]] = []
+    window = [0]
+    lookback = [0]
+
+    def rec(p):
+        if not hasattr(p, "__dataclass_fields__"):
+            return
+        if isinstance(p, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing)):
+            grids.append((p.start_ms, p.step_ms, p.end_ms))
+            w = p.lookback_ms if isinstance(p, lp.PeriodicSeries) \
+                else p.window_ms
+            window[0] = max(window[0], w)
+            lookback[0] = max(lookback[0], w + p.offset_ms)
+            return
+        for f in p.__dataclass_fields__:
+            v = getattr(p, f)
+            if isinstance(v, tuple):
+                for x in v:
+                    rec(x)
+            else:
+                rec(v)
+
+    rec(plan)
+    if not grids or any(g != grids[0] for g in grids[1:]):
+        return None
+    s, st, e = grids[0]
+    return s, st, e, window[0], lookback[0]
+
+
+# plan node types whose evaluation range lp_replace_range can rewrite —
+# only these shapes may be split across the raw/downsample boundary
+_SPLITTABLE = (
+    lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing, lp.Aggregate,
+    lp.BinaryJoin, lp.ScalarVectorBinaryOperation, lp.ApplyInstantFunction,
+    lp.ApplyMiscellaneousFunction, lp.ApplySortFunction,
+    lp.ApplyLimitFunction, lp.ApplyAbsentFunction, lp.ScalarTimeBasedPlan,
+    lp.ScalarFixedDoublePlan, lp.ScalarVaryingDoublePlan,
+    lp.ScalarBinaryOperation, lp.VectorPlan, lp.RawSeriesPlan,
+)
+
+
+def _splittable(plan) -> bool:
+    if not hasattr(plan, "__dataclass_fields__") \
+            or isinstance(plan, ColumnFilter):
+        return True     # literals / filters
+    if not isinstance(plan, _SPLITTABLE):
+        return False
+    if getattr(plan, "at_ms", None) is not None:
+        return False    # @-pinned evaluation doesn't split on the grid
+    for f in plan.__dataclass_fields__:
+        v = getattr(plan, f)
+        if isinstance(v, tuple):
+            if not all(_splittable(x) for x in v):
+                return False
+        elif hasattr(v, "__dataclass_fields__"):
+            if not _splittable(v):
+                return False
+    return True
+
+
+def stitch_grids(first: GridResult, second: GridResult) -> GridResult:
+    """Merge two grid results onto the union step grid, matching series by
+    label key; on a shared step the first's non-NaN sample wins
+    (StitchRvsExec.scala:116 / :105 merge semantics)."""
+    if first.num_series == 0 and first.steps.size == 0:
+        return second
+    if second.num_series == 0 and second.steps.size == 0:
+        return first
+    steps = np.union1d(first.steps, second.steps)
+    hist = first.is_hist() or second.is_hist()
+    if hist:
+        les = first.bucket_les if first.is_hist() else second.bucket_les
+        if (first.is_hist() and second.is_hist()
+                and not np.array_equal(first.bucket_les,
+                                       second.bucket_les)):
+            raise QueryError("cannot stitch histogram results with "
+                             "different bucket schemes")
+        nb = les.size
+    key_ix: Dict[Tuple, int] = {}
+    keys: List[Dict[str, str]] = []
+    rows: List[np.ndarray] = []
+    hrows: List[np.ndarray] = []
+    for side in (first, second):
+        if side.num_series == 0:
+            continue
+        pos = np.searchsorted(steps, side.steps)
+        for i, k in enumerate(side.keys):
+            fk = tuple(sorted(k.items()))
+            j = key_ix.get(fk)
+            if j is None:
+                j = len(keys)
+                key_ix[fk] = j
+                keys.append(dict(k))
+                rows.append(np.full(steps.size, np.nan))
+                if hist:
+                    hrows.append(np.full((steps.size, nb), np.nan))
+            cur = rows[j][pos]
+            rows[j][pos] = np.where(np.isnan(cur), side.values[i], cur)
+            if hist and side.is_hist():
+                curh = hrows[j][pos]
+                hrows[j][pos] = np.where(np.isnan(curh),
+                                         side.hist_values[i], curh)
+    values = np.vstack([r[None] for r in rows]) if rows else \
+        np.zeros((0, steps.size))
+    hv = np.stack(hrows) if hist and hrows else None
+    return GridResult(steps, keys, values, hist_values=hv,
+                      bucket_les=les if hist else None)
+
+
 class ExecPlan:
     """Materialized plan node (query/exec/ExecPlan.scala:46)."""
 
@@ -168,6 +283,32 @@ class MeshAggregateExec(ExecPlan):
                 f"{pads}  func={self.function}, shards={shard_nums})")
 
 
+@dataclass
+class StitchExec(ExecPlan):
+    """Raw/downsample time-split: the downsample exec covers the steps
+    whose lookback windows fall beyond raw retention, the raw exec covers
+    the recent steps; results merge on the step grid
+    (LongTimeRangePlanner.scala:30 + StitchRvsExec.scala:116)."""
+    ds_exec: Optional[ExecPlan]
+    raw_exec: Optional[ExecPlan]
+
+    def execute(self):
+        parts = [e.execute() for e in (self.ds_exec, self.raw_exec)
+                 if e is not None]
+        parts = [p for p in parts if isinstance(p, GridResult)]
+        if not parts:
+            raise QueryError("stitch produced no grid results")
+        if len(parts) == 1:
+            return parts[0]
+        return stitch_grids(parts[0], parts[1])
+
+    def plan_tree(self, indent: int = 0) -> str:
+        pads = " " * indent
+        kids = [e.plan_tree(indent + 2)
+                for e in (self.ds_exec, self.raw_exec) if e is not None]
+        return f"{pads}StitchExec(\n" + "\n".join(kids) + ")"
+
+
 class QueryPlanner:
     """materialize(LogicalPlan) -> ExecPlan (QueryPlanner.scala:17;
     SingleClusterPlanner.scala:52). Also the execution facade the HTTP
@@ -179,7 +320,10 @@ class QueryPlanner:
                  mesh_executor: Optional[object] = None,
                  spread: int = 1,   # system default-spread; must match ingest
                  shard_key_columns: Tuple[str, ...] = ("_ws_", "_ns_"),
-                 metric_column: str = "_metric_"):
+                 metric_column: str = "_metric_",
+                 ds_store: Optional[object] = None,
+                 raw_retention_ms: int = 0,
+                 now_ms=None):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -189,6 +333,11 @@ class QueryPlanner:
         self.spread = spread
         self.shard_key_columns = tuple(shard_key_columns)
         self.metric_column = metric_column
+        # raw/downsample tiering (LongTimeRangePlanner.scala:30): queries
+        # reaching beyond `now - raw_retention_ms` split to the ds_store
+        self.ds_store = ds_store
+        self.raw_retention_ms = int(raw_retention_ms)
+        self.now_ms = now_ms        # int | callable | None (= wall clock)
         self.stats = QueryStats()
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
@@ -240,9 +389,16 @@ class QueryPlanner:
 
     # -- materialization -------------------------------------------------
     def materialize(self, plan) -> ExecPlan:
-        """(SingleClusterPlanner.scala:253). Pattern-matches the mesh-
-        lowerable aggregate shape; everything else runs locally over the
-        pruned shard subset."""
+        """(SingleClusterPlanner.scala:253). Raw/downsample tiering first
+        (LongTimeRangePlanner), then pattern-matches the mesh-lowerable
+        aggregate shape; everything else runs locally over the pruned
+        shard subset."""
+        tiered = self._try_tiering(plan)
+        if tiered is not None:
+            return tiered
+        return self._materialize_raw(plan)
+
+    def _materialize_raw(self, plan) -> ExecPlan:
         mesh_plan = self._try_mesh_lowering(plan)
         if mesh_plan is not None:
             return mesh_plan
@@ -251,6 +407,66 @@ class QueryPlanner:
 
     def execute(self, plan):
         return self.materialize(plan).execute()
+
+    # -- raw/downsample tiering (LongTimeRangePlanner.scala:30) -----------
+    def _earliest_raw_ms(self) -> int:
+        import time as _time
+        if callable(self.now_ms):
+            now = int(self.now_ms())
+        elif self.now_ms is not None:
+            now = int(self.now_ms)
+        else:
+            now = int(_time.time() * 1000)
+        return now - self.raw_retention_ms
+
+    def _try_tiering(self, plan) -> Optional[ExecPlan]:
+        """Split a plan whose step windows reach beyond raw retention into
+        a downsample-side exec + a raw-side exec, stitched. Returns None
+        when tiering doesn't apply (all-raw, untierable shape, or no exact
+        downsample mapping — those fall back to the raw store)."""
+        from filodb_tpu.query.engine import lp_replace_range
+
+        if self.ds_store is None or self.raw_retention_ms <= 0:
+            return None
+        if lp.is_metadata_plan(plan) or lp.is_scalar_plan(plan):
+            return None
+        rng = plan_range(plan)
+        if rng is None:
+            return None
+        start, step, end, window, lookback = rng
+        earliest_raw = self._earliest_raw_ms()
+        if start - lookback >= earliest_raw:
+            return None                                  # fully in raw
+        if not _splittable(plan):
+            return None
+        # first step whose whole lookback window sits inside raw retention
+        if step > 0 and end - lookback >= earliest_raw:
+            k = -((start - lookback - earliest_raw) // step)   # ceil div
+            boundary = start + k * step
+        elif end - lookback >= earliest_raw:
+            boundary = start                             # single instant, raw
+        else:
+            boundary = None                              # fully beyond raw
+        if boundary is not None and boundary <= start:
+            return None                                  # fully in raw
+        if boundary is None:
+            ds_plan = plan
+        else:
+            ds_plan = lp_replace_range(plan, start, step, boundary - step)
+        # instant queries (step<=0) have a single evaluation: resolution
+        # choice is governed by the window alone
+        eff_step = step if step > 0 else max(window, 1)
+        picked = self.ds_store.plan_query(ds_plan, max(window, 1), eff_step)
+        if picked is None:
+            return None     # no exact ds mapping: answer from raw only
+        ds_shards, ds_rewritten = picked
+        ds_exec = LocalEngineExec(ds_rewritten, ds_shards, self.backend,
+                                  self.stats)
+        raw_exec = None
+        if boundary is not None and boundary <= end:
+            raw_plan = lp_replace_range(plan, boundary, step, end)
+            raw_exec = self._materialize_raw(raw_plan)
+        return StitchExec(ds_exec=ds_exec, raw_exec=raw_exec)
 
     def _try_mesh_lowering(self, plan) -> Optional[MeshAggregateExec]:
         from filodb_tpu.query.tpu import DEVICE_FUNCS

@@ -49,6 +49,12 @@ DEFAULTS = {
     # (flush-interval in the reference source config)
     "flush-interval-s": 2.0,
     "flush-every-records": None,
+    # raw retention in seconds; queries reaching further back split to the
+    # downsample tier (LongTimeRangePlanner). Requires data-dir (the ds
+    # tier reads downsampler-job output from the ColumnStore). None = off.
+    "raw-retention-s": None,
+    # downsample resolutions in ms (conf multi-resolution config)
+    "downsample-resolutions": [300_000, 3_600_000],
 }
 
 
@@ -98,12 +104,23 @@ class FiloServer:
                     mesh_ex = MeshExecutor(make_mesh())
             except Exception:
                 mesh_ex = None
+        ds_stores: Dict[str, object] = {}
+        retention_ms = 0
+        if (self.config.get("raw-retention-s")
+                and self.store.column_store is not None):
+            from filodb_tpu.downsample import DownsampledTimeSeriesStore
+            retention_ms = int(self.config["raw-retention-s"]) * 1000
+            ds_stores[self.ref.dataset] = DownsampledTimeSeriesStore(
+                self.store.column_store, self.ref.dataset, n,
+                resolutions=tuple(self.config["downsample-resolutions"]))
         self.http = FiloHttpServer(
             {self.ref.dataset: self.store.shards(self.ref)},
             backend=self.backend, shard_mapper=self.mapper,
             mesh_executor=mesh_ex,
             spread=int(self.config.get("default-spread", 1)),
-            port=self.config["port"])
+            port=self.config["port"],
+            ds_store_by_dataset=ds_stores,
+            raw_retention_ms=retention_ms)
         self.http.start()
         if streaming:
             self._start_ingestion()

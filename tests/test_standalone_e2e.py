@@ -62,7 +62,9 @@ def _get(port, path, **params):
     url = f"http://127.0.0.1:{port}{path}"
     if qs:
         url += "?" + qs
-    with urllib.request.urlopen(url, timeout=30) as r:
+    # generous: under a full-suite run the server subprocess competes for
+    # CPU with other tests while JIT-compiling its first query
+    with urllib.request.urlopen(url, timeout=120) as r:
         return json.loads(r.read())
 
 

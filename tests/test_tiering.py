@@ -1,0 +1,202 @@
+"""Raw/downsample query tiering (LongTimeRangePlanner.scala:30 +
+StitchRvsExec.scala:116): queries reaching beyond raw retention split into
+a downsample-side exec and a raw-side exec, stitched on the step grid.
+
+Parity oracle: a shard holding the FULL history answers the same query
+all-raw; the tiered answer (recent-only raw shard + downsample store built
+by the batch job over the full history) must match.
+"""
+
+import numpy as np
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.downsample import (DownsampledTimeSeriesStore,
+                                   DownsamplerJob)
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.model import GridResult
+from filodb_tpu.query.planner import (QueryPlanner, StitchExec,
+                                      plan_range, stitch_grids)
+from filodb_tpu.store import FlatFileColumnStore
+
+REF = DatasetRef("timeseries")
+RES = 300_000                       # 5m downsample resolution
+T0 = (1_600_000_000_000 // RES) * RES
+SAMPLE_OFF = 5_000                  # samples 5s past period boundaries
+N_SAMPLES = 720                     # 2h at 10s
+SPAN_MS = N_SAMPLES * 10_000
+NOW = T0 + SPAN_MS
+RETENTION_MS = 1_800_000            # raw keeps the last 30min
+EARLIEST_RAW = NOW - RETENTION_MS
+
+
+def _add_all(builder, first, last):
+    """Gauges + counters for sample index range [first, last)."""
+    for s in range(3):
+        glabels = {"_metric_": "cpu", "_ws_": "demo", "_ns_": "App-0",
+                   "instance": f"i{s}"}
+        clabels = {"_metric_": "reqs_total", "_ws_": "demo",
+                   "_ns_": "App-0", "instance": f"i{s}"}
+        for t in range(first, last):
+            ts = T0 + SAMPLE_OFF + t * 10_000
+            builder.add_sample("gauge", glabels, ts,
+                               50.0 + 40.0 * np.sin(t / 7.0 + s))
+            builder.add_sample("prom-counter", clabels, ts,
+                               float((t + 1) * (s + 1)))
+
+
+def _mk_shard(first, last, column_store=None):
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0,
+                            column_store=column_store, max_chunk_rows=120)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    _add_all(b, first, last)
+    for c in b.containers():
+        shard.ingest(c)
+    if column_store is not None:
+        shard.flush_all(offset=1)
+    return shard
+
+
+def _setup(tmp_path):
+    # oracle: everything raw
+    full_shard = _mk_shard(0, N_SAMPLES)
+    # production: persisted full history -> downsampler job -> ds store,
+    # plus a raw shard holding only what retention keeps
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    _mk_shard(0, N_SAMPLES, column_store=cs)
+    DownsamplerJob(cs, resolutions=(RES,)).run("timeseries", 0)
+    first_kept = (EARLIEST_RAW - T0) // 10_000
+    recent_shard = _mk_shard(first_kept, N_SAMPLES)
+    ds_store = DownsampledTimeSeriesStore(cs, "timeseries", 1,
+                                          resolutions=(RES,))
+    planner = QueryPlanner([recent_shard], ds_store=ds_store,
+                           raw_retention_ms=RETENTION_MS, now_ms=NOW)
+    return full_shard, planner
+
+
+def _compare(full_shard, planner, query, tsp, rtol=0.0):
+    plan = parse_query_range(query, tsp)
+    want = QueryEngine([full_shard]).execute(plan)
+    got = planner.execute(plan)
+    assert isinstance(got, GridResult)
+    np.testing.assert_array_equal(got.steps, want.steps)
+    gmap = {tuple(sorted(k.items())): got.values[i]
+            for i, k in enumerate(got.keys)}
+    assert len(gmap) == want.num_series, query
+    for i, k in enumerate(want.keys):
+        g = gmap[tuple(sorted(k.items()))]
+        if rtol == 0.0:
+            np.testing.assert_allclose(g, want.values[i], rtol=1e-12,
+                                       equal_nan=True, err_msg=query)
+        else:
+            ok = np.isfinite(want.values[i]) & np.isfinite(g)
+            assert ok.sum() >= want.values[i].size - 2, query
+            np.testing.assert_allclose(g[ok], want.values[i][ok],
+                                       rtol=rtol, err_msg=query)
+
+
+def test_split_plan_shape(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, NOW // 1000)
+    plan = parse_query_range("min_over_time(cpu[10m])", tsp)
+    ex = planner.materialize(plan)
+    assert isinstance(ex, StitchExec)
+    assert ex.ds_exec is not None and ex.raw_exec is not None
+    # raw side starts at the first step whose window is inside retention
+    rng = plan_range(ex.raw_exec.plan)
+    assert rng[0] - rng[3] >= EARLIEST_RAW
+    # ds side ends exactly one step earlier
+    ds_rng = plan_range(ex.ds_exec.plan)
+    assert ds_rng[2] == rng[0] - rng[1]
+
+
+def test_gauge_queries_stitch_exactly(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    # step grid on period boundaries; 10m windows nest 5m ds periods
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, NOW // 1000)
+    for q in ["min_over_time(cpu[10m])",
+              "max_over_time(cpu[10m])",
+              "sum_over_time(cpu[10m])",
+              "count_over_time(cpu[10m])",
+              "sum(min_over_time(cpu[10m])) by (instance)",
+              "avg(max_over_time(cpu[10m]))"]:
+        _compare(full_shard, planner, q, tsp)
+
+
+def test_counter_rate_stitches(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, NOW // 1000)
+    # ds counter chunks keep period boundary samples: small extrapolation
+    # differences only
+    _compare(full_shard, planner, "increase(reqs_total[10m])", tsp,
+             rtol=0.05)
+    _compare(full_shard, planner, "sum(rate(reqs_total[10m]))", tsp,
+             rtol=0.05)
+
+
+def test_fully_beyond_retention_serves_from_ds(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    # whole query older than retention: every step from the ds tier
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600,
+                        (EARLIEST_RAW - 1_200_000) // 1000)
+    plan = parse_query_range("min_over_time(cpu[10m])", tsp)
+    ex = planner.materialize(plan)
+    assert isinstance(ex, StitchExec) and ex.raw_exec is None
+    _compare(full_shard, planner, "min_over_time(cpu[10m])", tsp)
+
+
+def test_recent_query_stays_raw(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams((EARLIEST_RAW + 1_200_000) // 1000, 600,
+                         NOW // 1000)
+    plan = parse_query_range("min_over_time(cpu[10m])", tsp)
+    ex = planner.materialize(plan)
+    assert not isinstance(ex, StitchExec)
+    _compare(full_shard, planner, "min_over_time(cpu[10m])", tsp)
+
+
+def test_no_ds_mapping_falls_back_to_raw(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, NOW // 1000)
+    # quantile_over_time has no exact ds column: raw-only (and therefore
+    # silent about the pre-retention region, matching reference behavior)
+    plan = parse_query_range("quantile_over_time(0.5, cpu[10m])", tsp)
+    ex = planner.materialize(plan)
+    assert not isinstance(ex, StitchExec)
+
+
+def test_replace_range_keeps_offset_in_raw_bounds():
+    """Regression: the tier split's range rewrite must shift raw fetch
+    bounds by the offset (the mesh path reads raw.start/end directly)."""
+    from filodb_tpu.query.engine import lp_replace_range
+    tsp = TimeStepParams(1000, 60, 2000)
+    plan = parse_query_range("rate(reqs_total[5m] offset 1h)", tsp)
+    out = lp_replace_range(plan, 1_500_000, 60_000, 2_000_000)
+    assert out.raw.start_ms == 1_500_000 - 300_000 - 3_600_000
+    assert out.raw.end_ms == 2_000_000 - 3_600_000
+
+
+def test_offset_query_stitches(tmp_path):
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams(T0 // 1000 + 3600, 600, NOW // 1000)
+    _compare(full_shard, planner,
+             "min_over_time(cpu[10m] offset 30m)", tsp)
+
+
+def test_stitch_grids_prefers_first_non_nan():
+    steps_a = np.array([0, 60, 120], dtype=np.int64)
+    steps_b = np.array([120, 180], dtype=np.int64)
+    a = GridResult(steps_a, [{"x": "1"}],
+                   np.array([[1.0, np.nan, 3.0]]))
+    b = GridResult(steps_b, [{"x": "1"}, {"x": "2"}],
+                   np.array([[9.0, 4.0], [7.0, 8.0]]))
+    out = stitch_grids(a, b)
+    np.testing.assert_array_equal(out.steps, [0, 60, 120, 180])
+    m = {k["x"]: out.values[i] for i, k in enumerate(out.keys)}
+    # overlap at 120: first side's non-NaN wins
+    np.testing.assert_allclose(m["1"], [1.0, np.nan, 3.0, 4.0],
+                               equal_nan=True)
+    np.testing.assert_allclose(m["2"], [np.nan, np.nan, 7.0, 8.0],
+                               equal_nan=True)
