@@ -29,7 +29,8 @@ from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.engine import QueryEngine  # noqa: F401 (re-export)
 from filodb_tpu.query.planner import QueryPlanner
-from filodb_tpu.query.model import GridResult, QueryError, ScalarResult
+from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
+                                    QueryLimits, ScalarResult)
 
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
 
@@ -44,7 +45,8 @@ class FiloHttpServer:
                  spread: int = 1,   # MUST match ingest spread (default-spread)
                  host: str = "127.0.0.1", port: int = 0,
                  ds_store_by_dataset: Optional[Dict[str, object]] = None,
-                 raw_retention_ms: int = 0):
+                 raw_retention_ms: int = 0,
+                 query_limits: Optional[QueryLimits] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -52,6 +54,7 @@ class FiloHttpServer:
         self.spread = spread
         self.ds_store_by_dataset = ds_store_by_dataset or {}
         self.raw_retention_ms = raw_retention_ms
+        self.query_limits = query_limits
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -91,6 +94,8 @@ class FiloHttpServer:
                     for k, v in urllib.parse.parse_qs(body).items():
                         qs.setdefault(k, []).extend(v)
             code, payload = self._route(parsed.path, qs)
+        except QueryLimitError as e:
+            code, payload = 422, prom_json.error(str(e), "query_limit")
         except QueryError as e:
             code, payload = 400, prom_json.error(str(e))
         except Exception as e:   # noqa: BLE001 — edge must not crash
@@ -120,7 +125,8 @@ class FiloHttpServer:
                               mesh_executor=self.mesh_executor,
                               spread=self.spread,
                               ds_store=self.ds_store_by_dataset.get(ds),
-                              raw_retention_ms=self.raw_retention_ms)
+                              raw_retention_ms=self.raw_retention_ms,
+                              limits=self.query_limits)
         if rest == "query_range":
             return self._query_range(engine, qs)
         if rest == "query":

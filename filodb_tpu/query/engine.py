@@ -26,8 +26,9 @@ from filodb_tpu.memory import histogram as bh
 from filodb_tpu.memory.vectors import counter_correction
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query import rangefn as rf
-from filodb_tpu.query.model import (GridResult, QueryError, QueryStats,
-                                    RangeParams, RawSeries, ScalarResult)
+from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
+                                    QueryStats, RangeParams, RawSeries,
+                                    ScalarResult)
 
 METRIC_LABELS = ("_metric_", "__name__")
 
@@ -45,7 +46,9 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                       start_ms: int, end_ms: int,
                       column: Optional[str] = None,
                       stats: Optional[QueryStats] = None,
-                      full: bool = False) -> List[RawSeries]:
+                      full: bool = False,
+                      limits: Optional[QueryLimits] = None
+                      ) -> List[RawSeries]:
     """Gather raw samples for all matching series across shards
     (SelectRawPartitionsExec.scala:159 doExecute; schema resolved per
     partition like MultiSchemaPartitionsExec).
@@ -92,6 +95,8 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                     stats.samples_scanned += hi - lo
                 else:
                     stats.samples_scanned += int(ts.size)
+                if limits is not None:
+                    limits.check(stats)     # abort before materializing more
     return out
 
 
@@ -767,10 +772,12 @@ class QueryEngine:
     per-shard leaf evaluation + mesh reductions."""
 
     def __init__(self, shards: Sequence[TimeSeriesShard],
-                 backend: Optional[object] = None):
+                 backend: Optional[object] = None,
+                 limits: Optional[QueryLimits] = None):
         self.shards = list(shards)
         self.stats = QueryStats()
         self.backend = backend  # TPU backend hook (query/tpu.py)
+        self.limits = limits    # per-query guardrails (None = off)
 
     # -- public ----------------------------------------------------------
     def execute(self, plan):
@@ -800,10 +807,20 @@ class QueryEngine:
     # -- vector evaluation ------------------------------------------------
     def _eval(self, plan) -> GridResult:
         if isinstance(plan, lp.PeriodicSeries):
+            if plan.at_ms is not None:
+                return self._at_pinned(plan.raw, plan.at_ms, None,
+                                       plan.lookback_ms, (), plan.offset_ms,
+                                       plan.start_ms, plan.step_ms,
+                                       plan.end_ms)
             return self._periodic(plan.raw, plan.start_ms, plan.step_ms,
                                   plan.end_ms, None, plan.lookback_ms, (),
                                   plan.offset_ms)
         if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+            if plan.at_ms is not None:
+                return self._at_pinned(plan.raw, plan.at_ms, plan.function,
+                                       plan.window_ms, plan.func_args,
+                                       plan.offset_ms, plan.start_ms,
+                                       plan.step_ms, plan.end_ms)
             return self._periodic(plan.raw, plan.start_ms, plan.step_ms,
                                   plan.end_ms, plan.function, plan.window_ms,
                                   plan.func_args, plan.offset_ms)
@@ -853,7 +870,8 @@ class QueryEngine:
             # raw export (query endpoint with [range] at top level)
             series = select_raw_series(self.shards, plan.filters,
                                        plan.start_ms, plan.end_ms,
-                                       plan.column, self.stats)
+                                       plan.column, self.stats,
+                                       limits=self.limits)
             return series
         raise QueryError(f"cannot execute plan {type(plan).__name__}")
 
@@ -863,7 +881,7 @@ class QueryEngine:
         fetch_end = end_ms - offset_ms if offset_ms else end_ms
         series = select_raw_series(
             self.shards, raw.filters, fetch_start, fetch_end, raw.column,
-            self.stats, full=True)
+            self.stats, full=True, limits=self.limits)
         params = RangeParams(start_ms, step_ms, end_ms)
         if self.backend is not None and function is not None:
             out = self.backend.periodic_samples(
@@ -874,6 +892,26 @@ class QueryEngine:
         return periodic_samples(clip_series(series, fetch_start, fetch_end),
                                 params, function, window_ms,
                                 func_args, offset_ms)
+
+    def _at_pinned(self, raw: lp.RawSeriesPlan, at_ms: int, function,
+                   window_ms, func_args, offset_ms, start_ms, step_ms,
+                   end_ms) -> GridResult:
+        """`@` modifier: evaluate the selector once at the pinned instant
+        (window ends at at_ms - offset) and broadcast that value across the
+        whole step grid — Prometheus @-modifier semantics. `_periodic`
+        derives fetch bounds from its grid, so pinning the grid to [at_ms]
+        also fetches the right data range even when at_ms lies far outside
+        [start, end]."""
+        one = self._periodic(raw, at_ms, 0, at_ms, function, window_ms,
+                             func_args, offset_ms)
+        steps = RangeParams(start_ms, step_ms, end_ms).steps
+        values = np.repeat(one.values, steps.size, axis=1) \
+            if one.num_series else np.zeros((0, steps.size))
+        hv = None
+        if one.is_hist():
+            hv = np.repeat(one.hist_values, steps.size, axis=1)
+        return GridResult(steps, one.keys, values, hist_values=hv,
+                          bucket_les=one.bucket_les)
 
     def _subquery(self, plan: lp.SubqueryWithWindowing) -> GridResult:
         """func(expr[w:s]): evaluate inner on the subquery grid, then window

@@ -107,6 +107,29 @@ class QueryError(Exception):
     pass
 
 
+class QueryLimitError(QueryError):
+    """A per-query guardrail tripped (ExecPlan.scala:46 enforceLimits —
+    the reference aborts plans exceeding sample/series budgets)."""
+
+
+@dataclass(frozen=True)
+class QueryLimits:
+    """Per-query guardrails, enforced at series-selection time
+    (core/query/QueryContext PlannerParams enforcedLimits). 0 = off."""
+    series_limit: int = 0
+    sample_limit: int = 0
+
+    def check(self, stats: "QueryStats") -> None:
+        if self.series_limit and stats.series_scanned > self.series_limit:
+            raise QueryLimitError(
+                f"query matched {stats.series_scanned} series, exceeding "
+                f"the limit of {self.series_limit}")
+        if self.sample_limit and stats.samples_scanned > self.sample_limit:
+            raise QueryLimitError(
+                f"query would scan more than {self.sample_limit} samples "
+                f"(scanned {stats.samples_scanned} so far)")
+
+
 @dataclass
 class QueryWarnings:
     messages: List[str] = field(default_factory=list)

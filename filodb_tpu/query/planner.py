@@ -35,8 +35,8 @@ from filodb_tpu.core.record import shard_key_hash
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.engine import (METRIC_LABELS, QueryEngine,
                                      select_raw_series)
-from filodb_tpu.query.model import (GridResult, QueryError, QueryStats,
-                                    RangeParams)
+from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
+                                    QueryStats, RangeParams)
 
 # aggregations executable as mesh collectives (parallel/mesh.py MESH_AGGS)
 _MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
@@ -74,13 +74,15 @@ class PlannerParams:
 
 
 def plan_range(plan) -> Optional[Tuple[int, int, int, int, int]]:
-    """(start_ms, step_ms, end_ms, max_window_ms, max_lookback_ms) of the
+    """(start_ms, step_ms, end_ms, min_window_ms, max_lookback_ms) of the
     evaluation grid shared by all periodic nodes, or None when the plan has
     no periodic node or the nodes disagree (e.g. nested subquery grids).
-    max_lookback additionally includes offsets — the earliest data instant
-    any step can touch is ``start - max_lookback``."""
+    min_window governs downsample resolution choice (every selector must
+    tolerate the chosen period); max_lookback additionally includes
+    offsets — the earliest data instant any step can touch is
+    ``start - max_lookback``."""
     grids: List[Tuple[int, int, int]] = []
-    window = [0]
+    window = [1 << 62]
     lookback = [0]
 
     def rec(p):
@@ -90,7 +92,7 @@ def plan_range(plan) -> Optional[Tuple[int, int, int, int, int]]:
             grids.append((p.start_ms, p.step_ms, p.end_ms))
             w = p.lookback_ms if isinstance(p, lp.PeriodicSeries) \
                 else p.window_ms
-            window[0] = max(window[0], w)
+            window[0] = min(window[0], w)
             lookback[0] = max(lookback[0], w + p.offset_ms)
             return
         for f in p.__dataclass_fields__:
@@ -206,9 +208,11 @@ class LocalEngineExec(ExecPlan):
     shards: Sequence[object]
     backend: Optional[object]
     stats: QueryStats
+    limits: Optional[QueryLimits] = None
 
     def execute(self):
-        eng = QueryEngine(self.shards, backend=self.backend)
+        eng = QueryEngine(self.shards, backend=self.backend,
+                          limits=self.limits)
         out = eng.execute(self.plan)
         self.stats.add(eng.stats)
         return out
@@ -238,19 +242,25 @@ class MeshAggregateExec(ExecPlan):
     shards: Sequence[object]
     mesh_executor: object
     stats: QueryStats
+    limits: Optional[QueryLimits] = None
 
     def execute(self) -> GridResult:
         from filodb_tpu.query.engine import clip_series
 
         n_mesh = self.mesh_executor.mesh.shape["shard"]
         series_by_shard: List[List] = []
+        # limits budget is per-query: check against fresh stats, then fold
+        # into the planner-lifetime counters
+        qstats = QueryStats()
         for shard in self.shards:
             row = select_raw_series(
                 [shard], self.raw.filters, self.raw.start_ms,
-                self.raw.end_ms, self.raw.column, self.stats, full=True)
+                self.raw.end_ms, self.raw.column, qstats, full=True,
+                limits=self.limits)
             # pack/ship only the query span, not the whole retention
             series_by_shard.append(
                 clip_series(row, self.raw.start_ms, self.raw.end_ms))
+        self.stats.add(qstats)
         # histograms are not mesh-lowerable; caller pre-checked 1-D only
         # pad the shard list to a multiple of the mesh shard axis
         while len(series_by_shard) % n_mesh:
@@ -323,7 +333,8 @@ class QueryPlanner:
                  metric_column: str = "_metric_",
                  ds_store: Optional[object] = None,
                  raw_retention_ms: int = 0,
-                 now_ms=None):
+                 now_ms=None,
+                 limits: Optional[QueryLimits] = None):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -338,6 +349,7 @@ class QueryPlanner:
         self.ds_store = ds_store
         self.raw_retention_ms = int(raw_retention_ms)
         self.now_ms = now_ms        # int | callable | None (= wall clock)
+        self.limits = limits        # per-query guardrails (None = off)
         self.stats = QueryStats()
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
@@ -403,7 +415,7 @@ class QueryPlanner:
         if mesh_plan is not None:
             return mesh_plan
         return LocalEngineExec(plan, self._resolve_shards(plan),
-                               self.backend, self.stats)
+                               self.backend, self.stats, self.limits)
 
     def execute(self, plan):
         return self.materialize(plan).execute()
@@ -461,7 +473,7 @@ class QueryPlanner:
             return None     # no exact ds mapping: answer from raw only
         ds_shards, ds_rewritten = picked
         ds_exec = LocalEngineExec(ds_rewritten, ds_shards, self.backend,
-                                  self.stats)
+                                  self.stats, self.limits)
         raw_exec = None
         if boundary is not None and boundary <= end:
             raw_plan = lp_replace_range(plan, boundary, step, end)
@@ -499,7 +511,7 @@ class QueryPlanner:
             offset_ms=inner.offset_ms,
             params=RangeParams(inner.start_ms, inner.step_ms, inner.end_ms),
             raw=raw, shards=shards, mesh_executor=self.mesh,
-            stats=self.stats)
+            stats=self.stats, limits=self.limits)
 
     @staticmethod
     def _selects_histograms(shards, raw: lp.RawSeriesPlan) -> bool:

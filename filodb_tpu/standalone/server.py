@@ -22,6 +22,7 @@ from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
 from filodb_tpu.http.server import FiloHttpServer
 from filodb_tpu.parallel.shardmapper import (ShardMapper,
                                              assign_shards_evenly)
+from filodb_tpu.query.model import QueryLimits
 
 DEFAULTS = {
     "dataset": "timeseries",
@@ -55,6 +56,10 @@ DEFAULTS = {
     "raw-retention-s": None,
     # downsample resolutions in ms (conf multi-resolution config)
     "downsample-resolutions": [300_000, 3_600_000],
+    # per-query guardrails (filodb-defaults.conf sample-limit equivalent;
+    # 0 = unlimited). Over-limit queries return HTTP 422.
+    "query-sample-limit": 1_000_000,
+    "query-series-limit": 100_000,
 }
 
 
@@ -120,7 +125,10 @@ class FiloServer:
             spread=int(self.config.get("default-spread", 1)),
             port=self.config["port"],
             ds_store_by_dataset=ds_stores,
-            raw_retention_ms=retention_ms)
+            raw_retention_ms=retention_ms,
+            query_limits=QueryLimits(
+                series_limit=int(self.config.get("query-series-limit", 0)),
+                sample_limit=int(self.config.get("query-sample-limit", 0))))
         self.http.start()
         if streaming:
             self._start_ingestion()

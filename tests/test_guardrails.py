@@ -1,0 +1,191 @@
+"""`@` modifier execution + per-query limits.
+
+(@: Prometheus @-modifier pins selector evaluation to one instant and
+broadcasts it across the step grid. Limits: ExecPlan.scala:46 enforces
+sample/series budgets per plan; over-limit queries abort with an error
+instead of OOMing the node.)
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
+                                      parse_query_range)
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.model import QueryLimitError, QueryLimits
+
+T0 = 1_600_000_000_000
+N = 360
+
+
+def _mk_shard():
+    shard = TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, 0)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s in range(3):
+        g = {"_metric_": "cpu", "_ws_": "demo", "_ns_": "App-0",
+             "instance": f"i{s}"}
+        c = {"_metric_": "reqs_total", "_ws_": "demo", "_ns_": "App-0",
+             "instance": f"i{s}"}
+        for t in range(N):
+            ts = T0 + t * 10_000
+            b.add_sample("gauge", g, ts, float(t + 100 * s))
+            b.add_sample("prom-counter", c, ts, float((t + 1) * (s + 1)))
+    for cont in b.containers():
+        shard.ingest(cont)
+    return shard
+
+
+# --- @ modifier ------------------------------------------------------------
+
+def test_at_pins_instant_selector_across_grid():
+    shard = _mk_shard()
+    at_s = (T0 + 1_000_000) // 1000            # t index 100
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1200)
+    plan = parse_query_range(f"cpu @ {at_s}", tsp)
+    got = QueryEngine([shard]).execute(plan)
+    assert got.num_series == 3
+    vals = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for s in range(3):
+        expect = float(100 + 100 * s)          # value at t=100
+        np.testing.assert_allclose(vals[f"i{s}"],
+                                   np.full(got.steps.size, expect))
+
+
+def test_at_matches_unpinned_instant_eval():
+    """rate(...[5m] @ t) must equal rate(...[5m]) evaluated at t."""
+    shard = _mk_shard()
+    at_s = (T0 + 2_000_000) // 1000
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1800)
+    pinned = QueryEngine([shard]).execute(
+        parse_query_range(f"rate(reqs_total[5m] @ {at_s})", tsp))
+    plain = QueryEngine([shard]).execute(
+        parse_query(f"rate(reqs_total[5m])", at_s))
+    pv = {k["instance"]: pinned.values[i]
+          for i, k in enumerate(pinned.keys)}
+    for i, k in enumerate(plain.keys):
+        want = plain.values[i][0]
+        np.testing.assert_allclose(pv[k["instance"]],
+                                   np.full(pinned.steps.size, want))
+
+
+def test_at_outside_query_range_fetches_data():
+    """@ far before the query range still finds the pinned data."""
+    shard = _mk_shard()
+    at_s = (T0 + 300_000) // 1000              # t=30, well before start
+    tsp = TimeStepParams(T0 // 1000 + 3000, 60, T0 // 1000 + 3500)
+    got = QueryEngine([shard]).execute(
+        parse_query_range(f"cpu @ {at_s}", tsp))
+    vals = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for s in range(3):
+        np.testing.assert_allclose(vals[f"i{s}"],
+                                   np.full(got.steps.size,
+                                           float(30 + 100 * s)))
+
+
+def test_at_with_offset():
+    """offset composes with @: data window ends at at - offset."""
+    shard = _mk_shard()
+    at_s = (T0 + 1_000_000) // 1000
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1200)
+    got = QueryEngine([shard]).execute(
+        parse_query_range(f"cpu @ {at_s} offset 5m", tsp))
+    vals = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for s in range(3):
+        expect = float(70 + 100 * s)           # value at t=100-30
+        np.testing.assert_allclose(vals[f"i{s}"],
+                                   np.full(got.steps.size, expect))
+
+
+def test_at_in_aggregate():
+    shard = _mk_shard()
+    at_s = (T0 + 1_000_000) // 1000
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1200)
+    got = QueryEngine([shard]).execute(
+        parse_query_range(f"sum(cpu @ {at_s})", tsp))
+    assert got.num_series == 1
+    np.testing.assert_allclose(
+        got.values[0], np.full(got.steps.size, float(100 + 200 + 300)))
+
+
+# --- limits ----------------------------------------------------------------
+
+def test_series_limit_aborts_selection():
+    shard = _mk_shard()
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1200)
+    plan = parse_query_range("cpu", tsp)
+    eng = QueryEngine([shard], limits=QueryLimits(series_limit=2))
+    with pytest.raises(QueryLimitError, match="series"):
+        eng.execute(plan)
+
+
+def test_sample_limit_aborts_selection():
+    shard = _mk_shard()
+    tsp = TimeStepParams(T0 // 1000, 60, T0 // 1000 + 3600)
+    plan = parse_query_range("rate(reqs_total[5m])", tsp)
+    eng = QueryEngine([shard], limits=QueryLimits(sample_limit=100))
+    with pytest.raises(QueryLimitError, match="samples"):
+        eng.execute(plan)
+
+
+def test_under_limit_query_succeeds():
+    shard = _mk_shard()
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1200)
+    plan = parse_query_range("cpu", tsp)
+    eng = QueryEngine([shard], limits=QueryLimits(series_limit=10,
+                                                  sample_limit=10_000))
+    out = eng.execute(plan)
+    assert out.num_series == 3
+
+
+def test_mesh_limit_budget_is_per_query():
+    """Regression: a reused planner with a mesh executor must not
+    accumulate scanned samples across queries into the limit check."""
+    import jax
+
+    from filodb_tpu.parallel.mesh import MeshExecutor, make_mesh
+    from filodb_tpu.query.planner import MeshAggregateExec, QueryPlanner
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    shard = _mk_shard()
+    tsp = TimeStepParams(T0 // 1000 + 600, 60, T0 // 1000 + 1800)
+    plan = parse_query_range("sum(rate(reqs_total[5m]))", tsp)
+    planner = QueryPlanner([shard], mesh_executor=MeshExecutor(make_mesh()),
+                           limits=QueryLimits(sample_limit=2000))
+    ex = planner.materialize(plan)
+    assert isinstance(ex, MeshAggregateExec)
+    for _ in range(5):      # each query scans ~1080 samples; 5x > limit
+        out = planner.materialize(plan).execute()
+        assert out.num_series == 1
+
+
+def test_http_over_limit_returns_422():
+    shard = _mk_shard()
+    srv = FiloHttpServer({"timeseries": [shard]},
+                         query_limits=QueryLimits(series_limit=2))
+    srv.start()
+    try:
+        url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+               f"query_range?query=cpu&start={T0 // 1000 + 600}"
+               f"&end={T0 // 1000 + 1200}&step=60")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=30)
+        assert ei.value.code == 422
+        body = json.loads(ei.value.read())
+        assert body["errorType"] == "query_limit"
+        # health and under-limit queries still fine
+        ok = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+            f"query_range?query=cpu{{instance=\"i0\"}}"
+            f"&start={T0 // 1000 + 600}&end={T0 // 1000 + 1200}&step=60",
+            timeout=30).read())
+        assert ok["status"] == "success"
+    finally:
+        srv.stop()

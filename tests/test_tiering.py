@@ -185,6 +185,19 @@ def test_offset_query_stitches(tmp_path):
              "min_over_time(cpu[10m] offset 30m)", tsp)
 
 
+def test_mixed_windows_use_min_for_resolution(tmp_path):
+    """Regression: a small window alongside a large one must veto a
+    resolution too coarse for the small window (else silently wrong)."""
+    full_shard, planner = _setup(tmp_path)
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, NOW // 1000)
+    # 10m window alone would pick res=5m; the 5m window (5m < 2*5m)
+    # rejects it -> whole query answers from raw (no stitch)
+    plan = parse_query_range(
+        "min_over_time(cpu[10m]) + min_over_time(cpu[5m])", tsp)
+    ex = planner.materialize(plan)
+    assert not isinstance(ex, StitchExec)
+
+
 def test_stitch_grids_prefers_first_non_nan():
     steps_a = np.array([0, 60, 120], dtype=np.int64)
     steps_b = np.array([120, 180], dtype=np.int64)
