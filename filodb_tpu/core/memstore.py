@@ -65,7 +65,7 @@ class ChunkSetInfo:
 
 
 def _is_hist(buf: bytes) -> bool:
-    return buf[:1] == bytes([bh.K_HIST_2D])
+    return buf[:1] in (bytes([bh.K_HIST_2D]), bytes([bh.K_HIST_SECT]))
 
 
 class TimeSeriesPartition:
@@ -200,17 +200,36 @@ class TimeSeriesPartition:
             return self._decoded_chunk_arrays_locked(col_index, col)
 
     def _decoded_chunk_arrays_locked(self, col_index: int, col):
-        """Body of _decoded_chunk_arrays; caller holds ``_cache_lock``."""
+        """Body of _decoded_chunk_arrays; caller holds ``_cache_lock``.
+
+        Entry layout: [next_chunk, ts_parts, val_parts, concat,
+        drop_rows, rows_so_far, prev_last_row]. The last three exist for
+        histogram columns only: drop_rows accumulates GLOBAL reset row
+        indices from each chunk's sectioned drop table (legacy unsectioned
+        chunks are rescanned once at decode), plus cross-chunk boundary
+        resets — so query-time counter correction never rescans buckets."""
         entry = self._decode_cache.get(col_index)
         if entry is None:
-            entry = [0, [], [], None]
+            entry = [0, [], [], None, [], 0, None]
             self._decode_cache[col_index] = entry
         n = len(self.chunks)
         if entry[0] < n:
             for c in self.chunks[entry[0]:n]:
                 entry[1].append(bv.decode_longs(c.vectors[0]))
                 if col.col_type == ColumnType.HISTOGRAM:
-                    _, _, vals = bh.decode_histograms(c.vectors[col_index])
+                    _, _, vals, drops = bh.decode_histograms_full(
+                        c.vectors[col_index])
+                    if drops is None:           # legacy K_HIST_2D chunk
+                        drops = bh.detect_drop_rows(vals)
+                    off, prev = entry[5], entry[6]
+                    if (prev is not None and vals.shape[0]
+                            and (vals[0] < prev).any()):
+                        entry[4].append(np.array([off], dtype=np.int64))
+                    if drops.size:
+                        entry[4].append(drops + off)
+                    entry[5] = off + vals.shape[0]
+                    if vals.shape[0]:
+                        entry[6] = vals[-1]
                 else:
                     vals = bv.decode_doubles(c.vectors[col_index])
                 entry[2].append(vals)
@@ -273,6 +292,33 @@ class TimeSeriesPartition:
         mvals.setflags(write=False)
         self._merge_cache[col_index] = (n_chunks, buf_ts.size, mts, mvals)
         return mts, mvals, cts.size
+
+    def hist_drop_rows(self, col_index: int) -> np.ndarray:
+        """Global reset row indices over this histogram column's full
+        (chunks + buffer tail) row sequence, from the sectioned drop
+        tables — readers hand these to hist_counter_correction instead of
+        rescanning (SectDelta's read-side payoff)."""
+        with self._cache_lock:
+            _, _ = self._decoded_chunk_arrays_locked(
+                col_index, self.schema.columns[col_index])
+            entry = self._decode_cache[col_index]
+            chunk_drops = (np.concatenate(entry[4]) if entry[4]
+                           else np.zeros(0, dtype=np.int64))
+            off, prev = entry[5], entry[6]
+            buf_ts, buf_cols = self.buffer_snapshot()
+        if not buf_ts.size:
+            return chunk_drops
+        rows = buf_cols[col_index - 1]
+        tail = np.stack(rows).astype(np.float64) if rows else \
+            np.zeros((0, 0))
+        parts = [chunk_drops]
+        if prev is not None and tail.shape[0] and tail.shape[1] \
+                and (tail[0] < prev).any():
+            parts.append(np.array([off], dtype=np.int64))
+        tail_drops = bh.detect_drop_rows(tail)
+        if tail_drops.size:
+            parts.append(tail_drops + off)
+        return np.concatenate(parts)
 
     def read_range(self, start_ts: int, end_ts: int, col_index: int
                    ) -> Tuple[np.ndarray, np.ndarray]:
@@ -492,6 +538,14 @@ class TimeSeriesShard:
                 part._chunk_seq = max(part._chunk_seq, len(part.chunks))
                 part._decode_cache.clear()
                 part._merge_cache.clear()
+            # bootstrapped shells never saw an ingest row: learn the bucket
+            # scheme from the paged-in chunk header
+            if infos and part._hist_scheme is None:
+                for ci, col in enumerate(part.schema.columns):
+                    if col.col_type == ColumnType.HISTOGRAM:
+                        part._hist_scheme = bh.hist_scheme_of(
+                            infos[0].vectors[ci])
+                        break
             part.odp_pending = False
             self.stats.partitions_paged_in += 1
 

@@ -60,14 +60,21 @@ class DownsamplerJob:
     # -- input ------------------------------------------------------------
     def _load_partitions(self, dataset: str, shard: int):
         """Decode every persisted partition's (ts, value-column) arrays.
-        Yields (part_key, schema, ts, vals)."""
+        Yields (part_key, schema, ts, vals). For histogram schemas vals is
+        a dict: {"cols": [per-double-column f64 arrays...],
+        "hist": [n, nb] f64, "scheme": bucket scheme}."""
         for e in self.store.scan_part_keys(dataset, shard):
             pk = PartKey.from_bytes(e.part_key)
             schema = self.schemas.by_id(pk.schema_id)
             vci = schema.value_column_index()
             col = schema.columns[vci]
             if col.col_type == ColumnType.HISTOGRAM:
-                yield pk, schema, None, None      # counted as skipped
+                got = self._load_hist_partition(dataset, shard, e, schema,
+                                                vci)
+                if got is not None:
+                    yield pk, schema, got[0], got[1]
+                else:
+                    yield pk, schema, None, None      # counted as skipped
                 continue
             ts_parts, val_parts = [], []
             for c in self.store.read_chunks(dataset, shard, e.part_key):
@@ -77,6 +84,36 @@ class DownsamplerJob:
                 continue
             yield (pk, schema, np.concatenate(ts_parts),
                    np.concatenate(val_parts))
+
+    def _load_hist_partition(self, dataset, shard, e, schema, vci):
+        """All columns of a histogram partition: (ts, payload dict).
+        Only the (ts, sum, count, h) shape is handled (prom-histogram /
+        delta-histogram layout); wider schemas are skipped."""
+        from filodb_tpu.memory import histogram as bh
+        dbl_idx = [i for i, c in enumerate(schema.columns)
+                   if i != 0 and i != vci]
+        if len(schema.columns) != 4 or len(dbl_idx) != 2:
+            return None
+        ts_parts, hist_parts, dbl_parts = [], [], [[] for _ in dbl_idx]
+        scheme = None
+        les = None
+        for c in self.store.read_chunks(dataset, shard, e.part_key):
+            ts_parts.append(bv.decode_longs(c.vectors[0]))
+            sch, _, mat = bh.decode_histograms(c.vectors[vci])
+            cur_les = sch.les()
+            if scheme is None:
+                scheme, les = sch, cur_les
+            elif not np.array_equal(les, cur_les):
+                return None     # bucket boundaries changed mid-history
+            hist_parts.append(mat)
+            for j, di in enumerate(dbl_idx):
+                dbl_parts[j].append(bv.decode_doubles(c.vectors[di]))
+        if not ts_parts or scheme is None:
+            return None
+        return (np.concatenate(ts_parts),
+                {"cols": [np.concatenate(p) for p in dbl_parts],
+                 "hist": np.concatenate(hist_parts, axis=0),
+                 "scheme": scheme})
 
     # -- output -----------------------------------------------------------
     def _out_shard(self, out_shards: Dict[str, TimeSeriesShard],
@@ -96,6 +133,7 @@ class DownsamplerJob:
         stats = DownsampleStats()
         gauges: List[Tuple[PartKey, object, np.ndarray, np.ndarray]] = []
         counters: List[Tuple[PartKey, object, np.ndarray, np.ndarray]] = []
+        hists: List[Tuple[PartKey, object, np.ndarray, dict]] = []
         for pk, schema, ts, vals in self._load_partitions(dataset, shard):
             if ts is None or not schema.downsamplers:
                 stats.skipped_schemas[schema.name] = \
@@ -104,14 +142,24 @@ class DownsamplerJob:
             if start_ms is not None or end_ms is not None:
                 lo = np.searchsorted(ts, start_ms or 0, side="left")
                 hi = np.searchsorted(ts, end_ms or (1 << 62), side="right")
-                ts, vals = ts[lo:hi], vals[lo:hi]
+                ts = ts[lo:hi]
+                if isinstance(vals, dict):
+                    vals = {"cols": [c[lo:hi] for c in vals["cols"]],
+                            "hist": vals["hist"][lo:hi],
+                            "scheme": vals["scheme"]}
+                else:
+                    vals = vals[lo:hi]
             if not ts.size:
                 continue
             stats.partitions_read += 1
             stats.samples_read += int(ts.size)
             marker = schema.downsample_period_marker
-            (counters if marker.startswith("counter") else gauges).append(
-                (pk, schema, ts, vals))
+            if isinstance(vals, dict):
+                hists.append((pk, schema, ts, vals))
+            elif marker.startswith("counter"):
+                counters.append((pk, schema, ts, vals))
+            else:
+                gauges.append((pk, schema, ts, vals))
 
         out_shards: Dict[str, TimeSeriesShard] = {}
         for batch in _batches(gauges, self.batch_series):
@@ -121,6 +169,9 @@ class DownsamplerJob:
             for batch in _batches(counters, self.batch_series):
                 self._downsample_counter_batch(batch, dataset, shard, res,
                                                out_shards, stats)
+            for item in hists:
+                self._downsample_hist_partition(item, dataset, shard, res,
+                                                out_shards, stats)
         for sh in out_shards.values():
             sh.flush_all()
         stats.chunks_written = sum(
@@ -223,6 +274,62 @@ class DownsamplerJob:
             for t, v in zip(ts_pad[i][m], vals_pad[i][m]):
                 cont.add(out_pk, int(t), float(v))
                 stats.samples_written += 1
+            out.ingest(cont)
+
+
+    def _downsample_hist_partition(self, item, dataset, shard, res,
+                                   out_shards, stats) -> None:
+        """One histogram partition → ds chunks at one resolution.
+
+        Cumulative schemas (downsample-period-marker = counter(N), e.g.
+        prom-histogram: hLast/dLast downsamplers) keep the period-boundary
+        samples of every column, marked by counter dips of the count
+        column — rate() over the ds data then sees the same increases.
+        Delta schemas (time marker, hSum/dSum) sum every column per period.
+        (ChunkDownsampler.scala:38-353 HistSumDownsampler/LastValueHDowns.)"""
+        pk, schema, ts, payload = item
+        sums, cnts = payload["cols"]
+        hist, scheme = payload["hist"], payload["scheme"]
+        marker = schema.downsample_period_marker
+        base = (int(ts[0]) // res) * res
+        nperiods = int((int(ts[-1]) - base) // res) + 1
+        ds_name = schema.downsample_schema or schema.name
+        ds_schema = self.schemas.by_name(ds_name)
+        out = self._out_shard(out_shards, dataset, res, shard)
+        cont = RecordContainer(ds_schema)
+        out_pk = PartKey(ds_schema.schema_id, pk.labels)
+        if marker.startswith("counter"):
+            n = ts.size
+            N = _next_pow2(n)       # pow2 pad: kernel compile reuse
+            ts_p = np.full(N, _TS_PAD, dtype=np.int64)
+            ts_p[:n] = ts
+            cn_p = np.zeros(N)
+            cn_p[:n] = cnts
+            mask = np.asarray(kernels.counter_emit_mask(
+                ts_p[None, :], cn_p[None, :],
+                np.array([n], dtype=np.int32),
+                np.int64(base), np.int64(res), nperiods))[0][:n]
+            for i in np.nonzero(mask)[0]:
+                cont.add(out_pk, int(ts[i]), float(sums[i]), float(cnts[i]),
+                         (scheme, hist[i].astype(np.int64)))
+                stats.samples_written += 1
+        else:
+            period = np.clip((ts - base) // res, 0, nperiods - 1)
+            pe_sum = np.zeros(nperiods)
+            pe_cnt = np.zeros(nperiods)
+            pe_hist = np.zeros((nperiods, hist.shape[1]))
+            pe_n = np.bincount(period, minlength=nperiods)
+            np.add.at(pe_sum, period, sums)
+            np.add.at(pe_cnt, period, cnts)
+            np.add.at(pe_hist, period, hist)
+            last_ts = np.zeros(nperiods, dtype=np.int64)
+            last_ts[period] = ts       # sorted: last write per period wins
+            for p in np.nonzero(pe_n)[0]:
+                cont.add(out_pk, int(last_ts[p]), float(pe_sum[p]),
+                         float(pe_cnt[p]),
+                         (scheme, pe_hist[p].astype(np.int64)))
+                stats.samples_written += 1
+        if len(cont):
             out.ingest(cont)
 
 

@@ -24,13 +24,18 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
 from filodb_tpu.memory import nibblepack as nbp
 
 K_HIST_2D = 16
+# Sectioned 2D-delta: same payload, plus an explicit drop-section table
+# (row indices where ANY bucket decreased) recorded at encode time — the
+# reader applies counter correction without rescanning buckets
+# (HistogramVector.scala:427 SectDelta / Section.scala drop sections).
+K_HIST_SECT = 17
 
 _U64 = (1 << 64) - 1
 
@@ -76,13 +81,34 @@ def _decode_scheme(buf: bytes, off: int):
     return CustomBuckets(tuple(les.tolist())), off + 3 + 8 * num
 
 
-def encode_histograms(scheme, rows: np.ndarray, counter: bool = True) -> bytes:
+def detect_drop_rows(rows: np.ndarray) -> np.ndarray:
+    """Row indices i>0 where ANY bucket decreased vs row i-1 — a counter
+    reset. Per-bucket detection catches partial drops the +Inf-only check
+    misses (HistogramVector.scala:427 SectDelta drop sections)."""
+    rows = np.asarray(rows)
+    if rows.shape[0] < 2:
+        return np.zeros(0, dtype=np.int64)
+    dropped = (np.diff(rows, axis=0) < 0).any(axis=1)
+    return np.nonzero(dropped)[0] + 1
+
+
+def encode_histograms(scheme, rows: np.ndarray, counter: bool = True,
+                      sectioned: bool = True) -> bytes:
     """Encode [num_rows, num_buckets] int64 bucket counts as a 2D-delta vector
-    (HistogramVector.scala:378 appendHistogram / DeltaDiffPackSink)."""
+    (HistogramVector.scala:378 appendHistogram / DeltaDiffPackSink).
+
+    ``sectioned`` (the default, SectDelta equivalent) additionally records
+    the drop-section table so readers get reset positions for free."""
     rows = np.asarray(rows, dtype=np.int64)
     n, nb = rows.shape if rows.size else (0, scheme.num)
-    out = bytearray(struct.pack("<BIB", K_HIST_2D, n, 1 if counter else 0))
+    kind = K_HIST_SECT if sectioned else K_HIST_2D
+    out = bytearray(struct.pack("<BIB", kind, n, 1 if counter else 0))
     out.extend(_encode_scheme(scheme))
+    if sectioned:
+        drops = detect_drop_rows(rows) if counter and n else \
+            np.zeros(0, dtype=np.int64)
+        out.extend(struct.pack("<H", drops.size))
+        out.extend(drops.astype("<u4").tobytes())
     if n == 0:
         return bytes(out)
     nbp.pack_delta(rows[0].astype(np.int64), out)
@@ -93,12 +119,21 @@ def encode_histograms(scheme, rows: np.ndarray, counter: bool = True) -> bytes:
     return bytes(out)
 
 
-def decode_histograms(buf: bytes):
-    """Decode to (scheme, counter_flag, [num_rows, num_buckets] float64)."""
+def decode_histograms_full(buf: bytes):
+    """Decode to (scheme, counter_flag, [num_rows, num_buckets] float64,
+    drop_rows). For sectioned vectors drop_rows comes from the encoded
+    section table; for plain 2D vectors it is None (caller rescans)."""
     kind, n, counter = struct.unpack_from("<BIB", buf, 0)
-    if kind != K_HIST_2D:
+    if kind not in (K_HIST_2D, K_HIST_SECT):
         raise ValueError(f"not a histogram vector: kind={kind}")
     scheme, off = _decode_scheme(buf, 6)
+    drops = None
+    if kind == K_HIST_SECT:
+        (n_drops,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        drops = np.frombuffer(buf, dtype="<u4", count=n_drops,
+                              offset=off).astype(np.int64)
+        off += 4 * n_drops
     nb = scheme.num
     rows = np.zeros((n, nb), dtype=np.int64)
     if n > 0:
@@ -108,20 +143,40 @@ def decode_histograms(buf: bytes):
             words, off = nbp.unpack_to_words(buf, off, nb)
             diffs = np.array(words, dtype=np.uint64).view(np.int64)
             rows[t] = rows[t - 1] + diffs
-    return scheme, bool(counter), rows.astype(np.float64)
+    return scheme, bool(counter), rows.astype(np.float64), drops
 
 
-def hist_counter_correction(rows: np.ndarray) -> np.ndarray:
+def decode_histograms(buf: bytes):
+    """Decode to (scheme, counter_flag, [num_rows, num_buckets] float64)."""
+    scheme, counter, rows, _ = decode_histograms_full(buf)
+    return scheme, counter, rows
+
+
+def hist_scheme_of(buf: bytes):
+    """Bucket scheme from a histogram vector's header alone (no payload
+    decode) — used when paging persisted chunks back into a partition."""
+    scheme, _ = _decode_scheme(buf, 6)
+    return scheme
+
+
+def hist_counter_correction(rows: np.ndarray,
+                            drop_rows: Optional[np.ndarray] = None
+                            ) -> np.ndarray:
     """Per-bucket reset correction, analogous to
-    vectors.counter_correction but on [n, nb] matrices
-    (HistogramVector.scala section drop detection)."""
+    vectors.counter_correction but on [n, nb] matrices. A reset is any
+    row where ANY bucket decreased (partial per-bucket drops count —
+    HistogramVector.scala:427 sectioned drop detection); the correction
+    adds back the full pre-reset histogram, Prometheus counter-reset
+    semantics applied bucket-wise. ``drop_rows`` (from a sectioned
+    vector's table) skips re-detection."""
     rows = np.asarray(rows, dtype=np.float64)
     if rows.shape[0] == 0:
         return np.zeros_like(rows)
-    diffs = np.diff(rows, axis=0)
-    # A reset drops ALL buckets; detect via the +Inf (last) bucket dropping.
-    dropped = diffs[:, -1] < 0
-    drops = np.where(dropped[:, None], rows[:-1], 0.0)
+    if drop_rows is None:
+        drop_rows = detect_drop_rows(rows)
+    dropped = np.zeros(rows.shape[0], dtype=bool)
+    dropped[drop_rows] = True
+    drops = np.where(dropped[1:, None], rows[:-1], 0.0)
     corr = np.zeros_like(rows)
     corr[1:] = np.cumsum(drops, axis=0)
     return corr

@@ -357,6 +357,13 @@ class Parser:
                 at_ms = int(float(at.text) * 1000)
                 if isinstance(e, Selector):
                     e.at_ms = at_ms
+                else:
+                    # Prometheus only allows @ on selectors/subqueries;
+                    # rejecting (rather than ignoring) avoids silently
+                    # unpinned answers for subqueries we don't pin yet
+                    raise ParseError(
+                        "@ modifier is only supported on vector and range "
+                        "selectors")
             else:
                 break
         return e

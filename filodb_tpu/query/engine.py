@@ -76,9 +76,12 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                 ts, vals = part.read_range(start_ms, end_ms, ci)
                 chunk_len, snap = -1, None
             les = None
+            drops = None
             if col.col_type == ColumnType.HISTOGRAM:
                 les = part._hist_scheme.les() if part._hist_scheme is not None \
                     else None
+                if full and col.is_counter_like:
+                    drops = part.hist_drop_rows(ci)
             out.append(RawSeries(
                 labels=dict(part.part_key.labels),
                 ts=ts, values=vals,
@@ -86,6 +89,7 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                 bucket_les=les,
                 snapshot_key=snap,
                 chunk_len=chunk_len if full else -1,
+                hist_drop_rows=drops,
             ))
             if stats is not None:
                 stats.series_scanned += 1
@@ -112,8 +116,12 @@ def clip_series(series: Sequence[RawSeries], start_ms: int, end_ms: int
         if lo == 0 and hi == s.ts.size:
             out.append(s)
         else:
+            dr = s.hist_drop_rows
+            if dr is not None:
+                dr = dr[(dr >= lo) & (dr < hi)] - lo
             out.append(RawSeries(s.labels, s.ts[lo:hi], s.values[lo:hi],
-                                 s.is_counter, s.bucket_les))
+                                 s.is_counter, s.bucket_les,
+                                 hist_drop_rows=dr))
     return out
 
 
@@ -183,8 +191,8 @@ def _hist_window(s: RawSeries, func: str, wstart, wend) -> np.ndarray:
     mat = s.values  # [n, nb]
     nb = mat.shape[1] if mat.size else 0
     if func in ("rate", "increase"):
-        corrected = mat + bh.hist_counter_correction(mat) if s.is_counter \
-            else mat
+        corrected = mat + bh.hist_counter_correction(
+            mat, drop_rows=s.hist_drop_rows) if s.is_counter else mat
         out = np.empty((nb, wstart.size))
         lo, hi = rf.window_bounds(ts, wstart, wend)
         counts = hi - lo + 1
@@ -614,9 +622,11 @@ def _time_component(grid: GridResult, func: str, keys) -> GridResult:
 def histogram_quantile(grid: GridResult, q: float) -> GridResult:
     """histogram_quantile over native histogram columns — vectorized over
     [S, T] (InstantFunction.scala HistogramQuantileImpl; bucket math
-    memory/format/vectors/Histogram.scala quantile)."""
+    memory/format/vectors/Histogram.scala quantile). Non-histogram input
+    falls back to the classic per-bucket `le`-series join
+    (exec/HistogramQuantileMapper.scala)."""
     if not grid.is_hist():
-        raise QueryError("histogram_quantile requires histogram input")
+        return _quantile_over_le_series(grid, q)
     hv = grid.hist_values  # [S, T, NB]
     les = np.asarray(grid.bucket_les, dtype=np.float64)
     S, T, NB = hv.shape
@@ -629,6 +639,53 @@ def histogram_quantile(grid: GridResult, q: float) -> GridResult:
             out[s, t] = bh.quantile(q, les, col)
     keys = [strip_metric(k) for k in grid.keys]
     return GridResult(grid.steps, keys, out)
+
+
+def _quantile_over_le_series(grid: GridResult, q: float) -> GridResult:
+    """histogram_quantile over classic per-bucket prom series: join series
+    sharing all labels except `le` into one cumulative histogram per step
+    (exec/HistogramQuantileMapper.scala — sorts bucket RVs by le, enforces
+    monotonicity like Prometheus' ensureMonotonic, then bucket math)."""
+    groups: Dict[Tuple, List[Tuple[float, int]]] = {}
+    for i, k in enumerate(grid.keys):
+        le_s = k.get("le")
+        if le_s is None:
+            continue        # non-bucket series are ignored (reference too)
+        try:
+            le = float(le_s.replace("+Inf", "inf")) \
+                if isinstance(le_s, str) else float(le_s)
+        except ValueError:
+            continue
+        base = tuple(sorted((kk, v) for kk, v in strip_metric(k).items()
+                            if kk != "le"))
+        groups.setdefault(base, []).append((le, i))
+    if not groups:
+        raise QueryError("histogram_quantile requires histogram input or "
+                         "per-bucket series with an 'le' label")
+    T = grid.steps.size
+    out_keys: List[Dict[str, str]] = []
+    rows: List[np.ndarray] = []
+    for base, members in groups.items():
+        members.sort(key=lambda m: m[0])
+        les = np.array([m[0] for m in members])
+        mat = grid.values[[m[1] for m in members]]   # [NB, T] cumulative
+        vals = np.full(T, np.nan)
+        for t in range(T):
+            col = mat[:, t]
+            present = ~np.isnan(col)     # a stale bucket series at this
+            if not present.any():        # step doesn't poison the rest
+                continue
+            lc = les[present]
+            if not np.isposinf(lc[-1]):
+                continue    # no +Inf bucket sample: NaN (Prometheus)
+            # Prometheus tolerates tiny non-monotonicity from float
+            # noise / scrape skew: running max down the buckets
+            vals[t] = bh.quantile(q, lc,
+                                  np.maximum.accumulate(col[present]))
+        out_keys.append(dict(base))
+        rows.append(vals)
+    values = np.vstack(rows) if rows else np.zeros((0, T))
+    return GridResult(grid.steps, out_keys, values)
 
 
 def histogram_bucket(grid: GridResult, le: float) -> GridResult:

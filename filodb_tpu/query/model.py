@@ -56,6 +56,9 @@ class RawSeries:
     bucket_les: Optional[np.ndarray] = None  # for histogram series
     snapshot_key: Optional[Tuple] = None
     chunk_len: int = -1     # -1: everything is immutable (no tail)
+    # histogram reset rows from the sectioned drop tables (row i = reset
+    # between rows i-1 and i); None = caller rescans buckets
+    hist_drop_rows: Optional[np.ndarray] = None
 
 
 @dataclass

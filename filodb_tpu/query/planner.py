@@ -110,6 +110,32 @@ def plan_range(plan) -> Optional[Tuple[int, int, int, int, int]]:
     return s, st, e, window[0], lookback[0]
 
 
+def _collect_at(plan) -> Tuple[List[int], int]:
+    """(@-pinned instants, total periodic-node count) under a plan."""
+    ats: List[int] = []
+    count = [0]
+
+    def rec(p):
+        if not hasattr(p, "__dataclass_fields__"):
+            return
+        if isinstance(p, (lp.PeriodicSeries,
+                          lp.PeriodicSeriesWithWindowing)):
+            count[0] += 1
+            if p.at_ms is not None:
+                ats.append(p.at_ms)
+            return
+        for f in p.__dataclass_fields__:
+            v = getattr(p, f)
+            if isinstance(v, tuple):
+                for x in v:
+                    rec(x)
+            else:
+                rec(v)
+
+    rec(plan)
+    return ats, count[0]
+
+
 # plan node types whose evaluation range lp_replace_range can rewrite —
 # only these shapes may be split across the raw/downsample boundary
 _SPLITTABLE = (
@@ -243,6 +269,7 @@ class MeshAggregateExec(ExecPlan):
     mesh_executor: object
     stats: QueryStats
     limits: Optional[QueryLimits] = None
+    hist_les: Optional[np.ndarray] = None
 
     def execute(self) -> GridResult:
         from filodb_tpu.query.engine import clip_series
@@ -261,19 +288,24 @@ class MeshAggregateExec(ExecPlan):
             series_by_shard.append(
                 clip_series(row, self.raw.start_ms, self.raw.end_ms))
         self.stats.add(qstats)
-        # histograms are not mesh-lowerable; caller pre-checked 1-D only
+        nb = len(self.hist_les) if self.hist_les is not None else 1
+        if self.hist_les is not None:
+            series_by_shard = [self._expand_hist(row)
+                               for row in series_by_shard]
         # pad the shard list to a multiple of the mesh shard axis
         while len(series_by_shard) % n_mesh:
             series_by_shard.append([])
-        # global group table: by-labels value tuple -> group id
+        # global group table: by-labels value tuple -> group id; histogram
+        # buckets ride as extra group lanes (gid*nb + bucket) and fold back
+        # into a [G, T, NB] grid after the collective
         group_keys: Dict[Tuple, int] = {}
         gids_by_shard: List[List[int]] = []
         for row in series_by_shard:
             gids = []
-            for s in row:
+            for j, s in enumerate(row):
                 key = tuple((l, s.labels.get(l, "")) for l in self.by)
                 gid = group_keys.setdefault(key, len(group_keys))
-                gids.append(gid)
+                gids.append(gid * nb + (j % nb) if nb > 1 else gid)
             gids_by_shard.append(gids)
         steps = self.params.steps
         if not group_keys:
@@ -281,10 +313,40 @@ class MeshAggregateExec(ExecPlan):
                               np.zeros((0, steps.size), dtype=np.float64))
         out = self.mesh_executor.window_aggregate(
             series_by_shard, self.params, self.function, self.window_ms,
-            self.agg_op, gids_by_shard, len(group_keys),
+            self.agg_op, gids_by_shard, len(group_keys) * nb,
             func_args=self.func_args, offset_ms=self.offset_ms)
         keys = [dict(k) for k in group_keys]
-        return GridResult(steps, keys, np.asarray(out))
+        out = np.asarray(out)
+        if self.hist_les is not None:
+            hv = out.reshape(len(keys), nb, steps.size).transpose(0, 2, 1)
+            return GridResult(steps, keys,
+                              np.full((len(keys), steps.size), np.nan),
+                              hist_values=hv, bucket_les=self.hist_les)
+        return GridResult(steps, keys, out)
+
+    def _expand_hist(self, row: List) -> List:
+        """Expand each histogram series into NB per-bucket pseudo-series.
+        Reset correction (any-bucket drop, sectioned semantics) is applied
+        HOST-side on the full matrix so the per-bucket device rows carry no
+        dips — the device counter correction is then the identity and the
+        result matches the oracle exactly."""
+        import dataclasses
+
+        from filodb_tpu.memory import histogram as bh
+        out: List = []
+        nb = len(self.hist_les)
+        for s in row:
+            mat = s.values
+            if s.is_counter and mat.size:
+                mat = mat + bh.hist_counter_correction(
+                    mat, drop_rows=s.hist_drop_rows)
+            for b in range(nb):
+                out.append(dataclasses.replace(
+                    s, values=mat[:, b] if mat.size else
+                    np.zeros(0, dtype=np.float64),
+                    bucket_les=None, snapshot_key=None,
+                    hist_drop_rows=None))
+        return out
 
     def plan_tree(self, indent: int = 0) -> str:
         pads = " " * indent
@@ -447,6 +509,27 @@ class QueryPlanner:
             return None
         start, step, end, window, lookback = rng
         earliest_raw = self._earliest_raw_ms()
+        ats, n_periodic = _collect_at(plan)
+        if ats:
+            # @-pinned selectors read at the pinned instant, not the grid:
+            # when every selector is pinned beyond raw retention, the whole
+            # plan routes to the downsample tier (no split — @ evaluates
+            # at one instant and broadcasts)
+            if len(ats) != n_periodic:
+                return None                 # mixed pinned/unpinned: raw
+            if min(ats) - lookback >= earliest_raw:
+                return None                 # pinned data still in raw
+            eff_step = step if step > 0 else max(window, 1)
+            picked = self.ds_store.plan_query(plan, max(window, 1),
+                                              eff_step)
+            if picked is None:
+                return None
+            ds_shards, ds_rewritten = picked
+            return StitchExec(
+                ds_exec=LocalEngineExec(ds_rewritten, ds_shards,
+                                        self.backend, self.stats,
+                                        self.limits),
+                raw_exec=None)
         if start - lookback >= earliest_raw:
             return None                                  # fully in raw
         if not _splittable(plan):
@@ -502,20 +585,34 @@ class QueryPlanner:
         shards = self._resolve_shards(plan)
         if not shards:
             return None
-        # histogram columns can't ride the [S,N] mesh tiles (yet)
-        if self._selects_histograms(shards, raw):
+        # histogram selections ride the mesh by bucket-expansion, but only
+        # for the sum(rate|increase(hist[w])) shape with one consistent
+        # bucket scheme; anything else falls back to the local engine
+        hist_kind, hist_les = self._hist_selection(shards, raw)
+        if hist_kind == "mixed":
             return None
+        if hist_kind == "hist":
+            if plan.op != "sum" or inner.function not in ("rate",
+                                                          "increase"):
+                return None
+            if hist_les is None:
+                return None
         return MeshAggregateExec(
             agg_op=plan.op, by=tuple(plan.by), function=inner.function,
             window_ms=inner.window_ms, func_args=tuple(inner.func_args),
             offset_ms=inner.offset_ms,
             params=RangeParams(inner.start_ms, inner.step_ms, inner.end_ms),
             raw=raw, shards=shards, mesh_executor=self.mesh,
-            stats=self.stats, limits=self.limits)
+            stats=self.stats, limits=self.limits, hist_les=hist_les)
 
     @staticmethod
-    def _selects_histograms(shards, raw: lp.RawSeriesPlan) -> bool:
+    def _hist_selection(shards, raw: lp.RawSeriesPlan):
+        """("none"|"hist"|"mixed", les or None): whether the selection hits
+        histogram columns, and the shared bucket scheme if consistent."""
         from filodb_tpu.core.schemas import ColumnType
+        saw_hist = saw_scalar = False
+        les = None
+        consistent = True
         for shard in shards:
             for part in shard.lookup_partitions(raw.filters, raw.start_ms,
                                                 raw.end_ms):
@@ -523,6 +620,20 @@ class QueryPlanner:
                 for c in part.schema.columns:
                     if c.name == name:
                         if c.col_type == ColumnType.HISTOGRAM:
-                            return True
+                            saw_hist = True
+                            sch = part._hist_scheme
+                            cur = sch.les() if sch is not None else None
+                            if cur is None:
+                                consistent = False
+                            elif les is None:
+                                les = cur
+                            elif not np.array_equal(les, cur):
+                                consistent = False
+                        else:
+                            saw_scalar = True
                         break
-        return False
+        if saw_hist and saw_scalar:
+            return "mixed", None
+        if saw_hist:
+            return "hist", (les if consistent else None)
+        return "none", None

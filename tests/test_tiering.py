@@ -198,6 +198,25 @@ def test_mixed_windows_use_min_for_resolution(tmp_path):
     assert not isinstance(ex, StitchExec)
 
 
+def test_at_pinned_beyond_retention_routes_to_ds(tmp_path):
+    """Regression: @ pinned before raw retention must consult the ds tier
+    (the step grid itself is recent, but the data read is not)."""
+    full_shard, planner = _setup(tmp_path)
+    at_s = (T0 + 1_800_000) // 1000          # well before earliest_raw
+    tsp = TimeStepParams((EARLIEST_RAW + 1_200_000) // 1000, 600,
+                         NOW // 1000)
+    plan = parse_query_range(f"min_over_time(cpu[10m] @ {at_s})", tsp)
+    ex = planner.materialize(plan)
+    assert isinstance(ex, StitchExec) and ex.raw_exec is None
+    got = ex.execute()
+    want = QueryEngine([full_shard]).execute(plan)
+    assert got.num_series == want.num_series == 3
+    gmap = {k["instance"]: got.values[i] for i, k in enumerate(got.keys)}
+    for i, k in enumerate(want.keys):
+        np.testing.assert_allclose(gmap[k["instance"]], want.values[i],
+                                   rtol=1e-12, equal_nan=True)
+
+
 def test_stitch_grids_prefers_first_non_nan():
     steps_a = np.array([0, 60, 120], dtype=np.int64)
     steps_b = np.array([120, 180], dtype=np.int64)
