@@ -46,7 +46,9 @@ class FiloHttpServer:
                  host: str = "127.0.0.1", port: int = 0,
                  ds_store_by_dataset: Optional[Dict[str, object]] = None,
                  raw_retention_ms: int = 0,
-                 query_limits: Optional[QueryLimits] = None):
+                 query_limits: Optional[QueryLimits] = None,
+                 node_id: Optional[str] = None,
+                 peers: Optional[Dict[str, str]] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -55,6 +57,10 @@ class FiloHttpServer:
         self.ds_store_by_dataset = ds_store_by_dataset or {}
         self.raw_retention_ms = raw_retention_ms
         self.query_limits = query_limits
+        # multi-process cluster plane (parallel/cluster.py): this node's id
+        # + peer node_id -> base URL for leaf dispatch and metadata fan-out
+        self.node_id = node_id
+        self.peers = dict(peers or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -86,6 +92,7 @@ class FiloHttpServer:
         try:
             parsed = urllib.parse.urlparse(req.path)
             qs = urllib.parse.parse_qs(parsed.query)
+            body_json = None
             if req.command == "POST":
                 ln = int(req.headers.get("Content-Length") or 0)
                 body = req.rfile.read(ln).decode() if ln else ""
@@ -93,7 +100,9 @@ class FiloHttpServer:
                 if "application/x-www-form-urlencoded" in ctype:
                     for k, v in urllib.parse.parse_qs(body).items():
                         qs.setdefault(k, []).extend(v)
-            code, payload = self._route(parsed.path, qs)
+                elif "application/json" in ctype and body:
+                    body_json = json.loads(body)
+            code, payload = self._route(parsed.path, qs, body_json)
         except QueryLimitError as e:
             code, payload = 422, prom_json.error(str(e), "query_limit")
         except QueryError as e:
@@ -107,12 +116,15 @@ class FiloHttpServer:
         req.end_headers()
         req.wfile.write(body)
 
-    def _route(self, path: str, qs: Dict):
+    def _route(self, path: str, qs: Dict, body_json=None):
         if path in ("/__health", "/__liveness", "/__readiness"):
             return 200, {"status": "healthy"}
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
         if m:
             return 200, self._cluster_status(m.group("ds"))
+        m = re.match(r"^/api/v1/raw/(?P<ds>[^/]+)$", path)
+        if m:
+            return self._raw_dispatch(m.group("ds"), body_json)
         m = _ROUTE.match(path)
         if not m:
             return 404, prom_json.error(f"no route for {path}", "not_found")
@@ -126,18 +138,20 @@ class FiloHttpServer:
                               spread=self.spread,
                               ds_store=self.ds_store_by_dataset.get(ds),
                               raw_retention_ms=self.raw_retention_ms,
-                              limits=self.query_limits)
+                              limits=self.query_limits,
+                              node_id=self.node_id, peers=self.peers,
+                              dataset=ds)
         if rest == "query_range":
             return self._query_range(engine, qs)
         if rest == "query":
             return self._query_instant(engine, qs)
         if rest == "labels":
-            return self._labels(engine, qs)
+            return self._labels(engine, qs, ds)
         lm = re.match(r"^label/(?P<name>[^/]+)/values$", rest)
         if lm:
-            return self._label_values(engine, lm.group("name"), qs)
+            return self._label_values(engine, lm.group("name"), qs, ds)
         if rest == "series":
-            return self._series(engine, qs)
+            return self._series(engine, qs, ds)
         return 404, prom_json.error(f"no route for {path}", "not_found")
 
     # -- endpoints --------------------------------------------------------
@@ -179,7 +193,7 @@ class FiloHttpServer:
                else 1 << 62)
         return start, end
 
-    def _labels(self, engine, qs):
+    def _labels(self, engine, qs, ds="timeseries"):
         # Prometheus semantics: result is the UNION over all match[]
         # selectors (none -> all series).
         start, end = self._time_range(qs)
@@ -188,18 +202,23 @@ class FiloHttpServer:
             filters = selector_to_filters(sel) if sel else ()
             out.update(engine.execute(lp.LabelNames(list(filters),
                                                     start, end)))
+        if self.peers:
+            out |= self._peer_metadata_union(ds, "labels", qs)
         return 200, prom_json.success(sorted(out))
 
-    def _label_values(self, engine, name, qs):
+    def _label_values(self, engine, name, qs, ds="timeseries"):
         start, end = self._time_range(qs)
         out: set = set()
         for sel in qs.get("match[]", []) or [None]:
             filters = selector_to_filters(sel) if sel else ()
             out.update(engine.execute(lp.LabelValues(name, list(filters),
                                                      start, end)))
+        if self.peers:
+            out |= self._peer_metadata_union(ds, f"label/{name}/values",
+                                             qs)
         return 200, prom_json.success(sorted(out))
 
-    def _series(self, engine, qs):
+    def _series(self, engine, qs, ds="timeseries"):
         start, end = self._time_range(qs)
         out = []
         seen = set()
@@ -211,6 +230,13 @@ class FiloHttpServer:
                 if key not in seen:
                     seen.add(key)
                     out.append(prom_json._metric(labels))
+        if self.peers:
+            for item in self._peer_metadata_union(ds, "series", qs):
+                labels = dict(item)
+                key = frozenset(labels.items())
+                if key not in seen:
+                    seen.add(key)
+                    out.append(labels)
         return 200, prom_json.success(out)
 
     def _cluster_status(self, ds):
@@ -225,3 +251,61 @@ class FiloHttpServer:
                        "address": self.shard_mapper.node_of(i)}
                       for i in range(self.shard_mapper.num_shards)]
         return prom_json.success(states)
+
+    # -- cluster plane ----------------------------------------------------
+    def _raw_dispatch(self, ds: str, body: Optional[Dict]):
+        """POST /api/v1/raw/{ds}: the leaf-dispatch endpoint peers call to
+        read raw series from THIS node's shards (PlanDispatcher.scala:21 —
+        the entry node evaluates the plan over the merged series)."""
+        from filodb_tpu.parallel.cluster import (series_to_wire,
+                                                 wire_to_filters)
+        from filodb_tpu.query.engine import select_raw_series
+        from filodb_tpu.query.model import QueryStats
+        if body is None:
+            return 400, prom_json.error("missing JSON body")
+        shards = self.shards_by_dataset.get(ds)
+        if shards is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
+        by_num = {getattr(s, "shard_num", i): s
+                  for i, s in enumerate(shards)}
+        want = body.get("shards")
+        subset = [by_num[n] for n in want if n in by_num] \
+            if want is not None else shards
+        series = select_raw_series(
+            subset, wire_to_filters(body.get("filters", [])),
+            int(body["start_ms"]), int(body["end_ms"]),
+            body.get("column"), QueryStats(), full=True,
+            limits=self.query_limits)
+        return 200, {"status": "success", "data": series_to_wire(series)}
+
+    def _peer_metadata_union(self, ds: str, rest: str, qs: Dict) -> set:
+        """Fan a labels/label-values request out to peers and union the
+        results (metadata scatter-gather; MetadataRemoteExec
+        equivalent)."""
+        import urllib.error
+        import urllib.request as ureq
+        out: set = set()
+        if qs.get("__local__"):
+            return out
+        for node, base in self.peers.items():
+            # the FailureDetector already marked dead peers' shards DOWN:
+            # don't block metadata requests waiting on them
+            if self.shard_mapper is not None:
+                shards = self.shard_mapper.shards_for_node(node)
+                if shards and not self.shard_mapper.active_shards(shards):
+                    continue
+            q = dict(qs)
+            q["__local__"] = ["1"]
+            url = (f"{base.rstrip('/')}/promql/{ds}/api/v1/{rest}?"
+                   + urllib.parse.urlencode(q, doseq=True))
+            try:
+                with ureq.urlopen(url, timeout=5) as r:
+                    payload = json.loads(r.read())
+                if payload.get("status") == "success":
+                    data = payload["data"]
+                    out.update(tuple(sorted(d.items()))
+                               if isinstance(d, dict) else d
+                               for d in data)
+            except (OSError, ValueError):
+                continue        # down peers: partial metadata
+        return out

@@ -59,6 +59,17 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     itself restricts the evaluation to the query range."""
     out: List[RawSeries] = []
     for shard in shards:
+        fetch_raw = getattr(shard, "fetch_raw", None)
+        if fetch_raw is not None:       # RemoteShardGroup: peer dispatch
+            got = fetch_raw(filters, start_ms, end_ms, column)
+            for s in got:
+                if stats is not None:
+                    stats.series_scanned += 1
+                    stats.samples_scanned += int(s.ts.size)
+                    if limits is not None:
+                        limits.check(stats)
+            out.extend(got)
+            continue
         for part in shard.lookup_partitions(filters, start_ms, end_ms):
             schema = part.schema
             col_name = column or schema.value_column
@@ -81,7 +92,10 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                 les = part._hist_scheme.les() if part._hist_scheme is not None \
                     else None
                 if full and col.is_counter_like:
+                    # taken after read_full's snapshot: rows appended in
+                    # between may carry drop indices beyond ts.size
                     drops = part.hist_drop_rows(ci)
+                    drops = drops[drops < ts.size]
             out.append(RawSeries(
                 labels=dict(part.part_key.labels),
                 ts=ts, values=vals,
@@ -840,21 +854,24 @@ class QueryEngine:
     def execute(self, plan):
         if lp.is_scalar_plan(plan):
             return eval_scalar(plan, self)
+        # metadata plans read local tag indexes only; cross-node metadata
+        # is unioned at the HTTP layer (peer fan-out)
+        local = [s for s in self.shards if not hasattr(s, "fetch_raw")]
         if isinstance(plan, lp.LabelValues):
             vals: set = set()
-            for s in self.shards:
+            for s in local:
                 vals.update(s.index.label_values(
                     plan.label, plan.filters, plan.start_ms, plan.end_ms))
             return sorted(vals)
         if isinstance(plan, lp.LabelNames):
             names: set = set()
-            for s in self.shards:
+            for s in local:
                 names.update(s.index.label_names(
                     plan.filters, plan.start_ms, plan.end_ms))
             return sorted(names)
         if isinstance(plan, lp.SeriesKeysByFilters):
             out = []
-            for s in self.shards:
+            for s in local:
                 for pid in s.index.part_ids_from_filters(
                         plan.filters, plan.start_ms, plan.end_ms):
                     out.append(dict(s.index.labels_for(pid)))

@@ -42,26 +42,35 @@ from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
 _MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
 
 
+def walk_plan_tree(plan, visit) -> None:
+    """Depth-first walk over a LogicalPlan's dataclass tree (the shared
+    recursion of walkLogicalPlanTree). ``visit(node) -> bool``: return
+    True to stop descending into that node's children."""
+    if plan is None or not hasattr(plan, "__dataclass_fields__"):
+        return
+    if visit(plan):
+        return
+    for f in plan.__dataclass_fields__:
+        v = getattr(plan, f)
+        if isinstance(v, tuple):
+            for item in v:
+                walk_plan_tree(item, visit)
+        else:
+            walk_plan_tree(v, visit)
+
+
 def walk_leaf_filters(plan) -> List[Tuple[ColumnFilter, ...]]:
     """Collect the filter sets of every RawSeries leaf under a plan
     (walkLogicalPlanTree's shard resolution inputs)."""
     out: List[Tuple[ColumnFilter, ...]] = []
 
-    def rec(p):
-        if p is None or isinstance(p, (int, float, str)):
-            return
+    def visit(p):
         if isinstance(p, lp.RawSeriesPlan):
             out.append(tuple(p.filters))
-            return
-        for f in getattr(p, "__dataclass_fields__", {}):
-            v = getattr(p, f)
-            if isinstance(v, tuple):
-                for item in v:
-                    rec(item)
-            else:
-                rec(v)
+            return True
+        return False
 
-    rec(plan)
+    walk_plan_tree(plan, visit)
     return out
 
 
@@ -85,25 +94,17 @@ def plan_range(plan) -> Optional[Tuple[int, int, int, int, int]]:
     window = [1 << 62]
     lookback = [0]
 
-    def rec(p):
-        if not hasattr(p, "__dataclass_fields__"):
-            return
+    def visit(p):
         if isinstance(p, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing)):
             grids.append((p.start_ms, p.step_ms, p.end_ms))
             w = p.lookback_ms if isinstance(p, lp.PeriodicSeries) \
                 else p.window_ms
             window[0] = min(window[0], w)
             lookback[0] = max(lookback[0], w + p.offset_ms)
-            return
-        for f in p.__dataclass_fields__:
-            v = getattr(p, f)
-            if isinstance(v, tuple):
-                for x in v:
-                    rec(x)
-            else:
-                rec(v)
+            return True
+        return False
 
-    rec(plan)
+    walk_plan_tree(plan, visit)
     if not grids or any(g != grids[0] for g in grids[1:]):
         return None
     s, st, e = grids[0]
@@ -115,24 +116,16 @@ def _collect_at(plan) -> Tuple[List[int], int]:
     ats: List[int] = []
     count = [0]
 
-    def rec(p):
-        if not hasattr(p, "__dataclass_fields__"):
-            return
+    def visit(p):
         if isinstance(p, (lp.PeriodicSeries,
                           lp.PeriodicSeriesWithWindowing)):
             count[0] += 1
             if p.at_ms is not None:
                 ats.append(p.at_ms)
-            return
-        for f in p.__dataclass_fields__:
-            v = getattr(p, f)
-            if isinstance(v, tuple):
-                for x in v:
-                    rec(x)
-            else:
-                rec(v)
+            return True
+        return False
 
-    rec(plan)
+    walk_plan_tree(plan, visit)
     return ats, count[0]
 
 
@@ -396,7 +389,10 @@ class QueryPlanner:
                  ds_store: Optional[object] = None,
                  raw_retention_ms: int = 0,
                  now_ms=None,
-                 limits: Optional[QueryLimits] = None):
+                 limits: Optional[QueryLimits] = None,
+                 node_id: Optional[str] = None,
+                 peers: Optional[Dict[str, str]] = None,
+                 dataset: str = "timeseries"):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -412,6 +408,12 @@ class QueryPlanner:
         self.raw_retention_ms = int(raw_retention_ms)
         self.now_ms = now_ms        # int | callable | None (= wall clock)
         self.limits = limits        # per-query guardrails (None = off)
+        # multi-process: this node's id + peer node_id -> base URL; shard
+        # numbers the mapper assigns to peers dispatch remotely
+        # (FiloDbClusterDiscovery.scala:50 / PlanDispatcher.scala:21)
+        self.node_id = node_id
+        self.peers = dict(peers or {})
+        self.dataset = dataset
         self.stats = QueryStats()
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
@@ -455,11 +457,30 @@ class QueryPlanner:
 
     def _queryable(self, nums: Optional[List[int]]) -> List[object]:
         if nums is None:
-            nums = sorted(self._by_num)
+            nums = sorted(self._by_num) if not self.peers else \
+                list(range(self.mapper.num_shards)) if self.mapper \
+                else sorted(self._by_num)
         if self.mapper is not None:
             ok = set(self.mapper.active_shards(nums))
             nums = [n for n in nums if n in ok]
-        return [self._by_num[n] for n in nums if n in self._by_num]
+        local = [self._by_num[n] for n in nums if n in self._by_num]
+        if not self.peers or self.mapper is None:
+            return local
+        # group non-local shard numbers by their owning peer node
+        from filodb_tpu.parallel.cluster import RemoteShardGroup
+        by_node: Dict[str, List[int]] = {}
+        for n in nums:
+            if n in self._by_num:
+                continue
+            node = self.mapper.node_of(n)
+            if node is None or node == self.node_id \
+                    or node not in self.peers:
+                continue
+            by_node.setdefault(node, []).append(n)
+        for node, group in sorted(by_node.items()):
+            local.append(RemoteShardGroup(node, self.peers[node],
+                                          self.dataset, group))
+        return local
 
     # -- materialization -------------------------------------------------
     def materialize(self, plan) -> ExecPlan:
@@ -519,6 +540,11 @@ class QueryPlanner:
                 return None                 # mixed pinned/unpinned: raw
             if min(ats) - lookback >= earliest_raw:
                 return None                 # pinned data still in raw
+            if max(ats) - lookback >= earliest_raw:
+                # instants straddle the boundary: the ds tier may not
+                # cover the recent one yet -> answer from raw (partial
+                # for the old instant, never silently empty for recent)
+                return None
             eff_step = step if step > 0 else max(window, 1)
             picked = self.ds_store.plan_query(plan, max(window, 1),
                                               eff_step)
@@ -584,6 +610,9 @@ class QueryPlanner:
             return None
         shards = self._resolve_shards(plan)
         if not shards:
+            return None
+        # cross-node leaves dispatch over HTTP, not the local device mesh
+        if any(hasattr(s, "fetch_raw") for s in shards):
             return None
         # histogram selections ride the mesh by bucket-expansion, but only
         # for the sum(rate|increase(hist[w])) shape with one consistent

@@ -21,7 +21,8 @@ from filodb_tpu.core.memstore import TimeSeriesMemStore
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
 from filodb_tpu.http.server import FiloHttpServer
 from filodb_tpu.parallel.shardmapper import (ShardMapper,
-                                             assign_shards_evenly)
+                                             assign_shards_evenly,
+                                             shards_for_ordinal)
 from filodb_tpu.query.model import QueryLimits
 
 DEFAULTS = {
@@ -60,6 +61,15 @@ DEFAULTS = {
     # 0 = unlimited). Over-limit queries return HTTP 422.
     "query-sample-limit": 1_000_000,
     "query-series-limit": 100_000,
+    # multi-process cluster (coordinator/v2 FiloDbClusterDiscovery.scala:50
+    # ordinal->shards; explicit peer list like the akka-bootstrapper's
+    # explicit-list mode): this node owns shards_for_ordinal(node-ordinal);
+    # peers maps node ids ("node0"...) -> base URLs for leaf dispatch
+    "num-nodes": 1,
+    "node-ordinal": 0,
+    "peers": {},
+    "failure-detect-interval-s": 0.5,
+    "failure-detect-threshold": 3,
 }
 
 
@@ -80,18 +90,36 @@ class FiloServer:
         self.streams: Dict[int, object] = {}
         self.drivers: list = []
         self.gateway = None
+        self.detector = None
+        self.node_id: str = self.config["node-id"]
+        self.owned_shards: list = []
 
     def start(self) -> "FiloServer":
         n = self.config["num-shards"]
-        for shard in range(n):
+        num_nodes = int(self.config.get("num-nodes", 1))
+        ordinal = int(self.config.get("node-ordinal", 0))
+        if num_nodes > 1:
+            self.node_id = f"node{ordinal}"
+            self.owned_shards = shards_for_ordinal(ordinal, num_nodes, n)
+        else:
+            self.node_id = self.config["node-id"]
+            self.owned_shards = list(range(n))
+        for shard in self.owned_shards:
             self.store.setup(self.ref, shard,
                              num_groups=self.config["groups-per-shard"],
                              max_chunk_rows=self.config["max-chunks-size"],
                              bootstrap=self.store.column_store is not None)
-        assign_shards_evenly(self.mapper, [self.config["node-id"]])
+        if num_nodes > 1:
+            for i in range(num_nodes):
+                for shard in shards_for_ordinal(i, num_nodes, n):
+                    self.mapper.assign(shard, f"node{i}")
+        else:
+            assign_shards_evenly(self.mapper, [self.node_id])
         streaming = bool(self.config.get("stream-dir"))
         if not streaming:
-            for shard in range(n):
+            # peers start ACTIVE optimistically; the failure detector
+            # flips them DOWN when health checks fail
+            for shard in range(n) if num_nodes > 1 else self.owned_shards:
                 self.mapper.activate(shard)
         if self.backend is None:
             try:
@@ -118,6 +146,9 @@ class FiloServer:
             ds_stores[self.ref.dataset] = DownsampledTimeSeriesStore(
                 self.store.column_store, self.ref.dataset, n,
                 resolutions=tuple(self.config["downsample-resolutions"]))
+        peers = {k: v for k, v in
+                 dict(self.config.get("peers") or {}).items()
+                 if k != self.node_id}
         self.http = FiloHttpServer(
             {self.ref.dataset: self.store.shards(self.ref)},
             backend=self.backend, shard_mapper=self.mapper,
@@ -128,8 +159,19 @@ class FiloServer:
             raw_retention_ms=retention_ms,
             query_limits=QueryLimits(
                 series_limit=int(self.config.get("query-series-limit", 0)),
-                sample_limit=int(self.config.get("query-sample-limit", 0))))
+                sample_limit=int(self.config.get("query-sample-limit", 0))),
+            node_id=self.node_id, peers=peers)
         self.http.start()
+        if peers:
+            from filodb_tpu.parallel.cluster import FailureDetector
+            shards_by_node = {node: self.mapper.shards_for_node(node)
+                              for node in peers}
+            self.detector = FailureDetector(
+                self.mapper, peers, shards_by_node,
+                interval_s=float(self.config.get(
+                    "failure-detect-interval-s", 0.5)),
+                threshold=int(self.config.get(
+                    "failure-detect-threshold", 3))).start()
         if streaming:
             self._start_ingestion()
         return self
@@ -170,19 +212,26 @@ class FiloServer:
             DEFAULT_SCHEMAS, num_shards=self.config["num-shards"])
         if start_ms is None:
             start_ms = (int(time.time()) - n_samples * 10) * 1000
+        owned = set(self.owned_shards)
+
+        def _mine(builders):
+            return {sh: b for sh, b in builders.items() if sh in owned}
         rows = 0
         rows += ingest_builders(self.store, self.ref,
-                                producer.gauges(start_ms, n_samples,
-                                                n_instances))
+                                _mine(producer.gauges(start_ms, n_samples,
+                                                      n_instances)))
         rows += ingest_builders(self.store, self.ref,
-                                producer.counters(start_ms, n_samples,
-                                                  n_instances))
+                                _mine(producer.counters(start_ms, n_samples,
+                                                        n_instances)))
         rows += ingest_builders(self.store, self.ref,
-                                producer.histograms(start_ms, n_samples))
+                                _mine(producer.histograms(start_ms,
+                                                          n_samples)))
         self.store.flush_all(self.ref)
         return rows
 
     def stop(self) -> None:
+        if self.detector is not None:
+            self.detector.stop()
         if self.gateway is not None:
             self.gateway.stop()
         for drv in self.drivers:
@@ -219,8 +268,11 @@ def main(argv=None) -> int:
         if v is not None:
             config[k.replace("_", "-")] = v
     server = FiloServer(config).start()
-    if args.seed_dev_data:
-        rows = server.seed_dev_data()
+    if args.seed_dev_data or config.get("seed-dev-data"):
+        rows = server.seed_dev_data(
+            n_samples=int(config.get("seed-samples", 360)),
+            n_instances=int(config.get("seed-instances", 4)),
+            start_ms=config.get("seed-start-ms"))
         print(f"seeded {rows} dev samples", file=sys.stderr)
     # machine-readable startup line (test harness / dev scripts read this)
     gw = server.gateway.port if server.gateway is not None else None

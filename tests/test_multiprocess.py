@@ -1,0 +1,217 @@
+"""Multi-process cluster e2e (the multi-jvm test analogue,
+coordinator/src/multi-jvm + FiloDbClusterDiscovery.scala:50): two OS
+processes each own half the shards; a query entering either node fans leaf
+selection out to the peer and returns the full series set; killing one
+node flips its shards DOWN on the survivor and queries exclude them.
+"""
+
+import json
+import os
+import pathlib
+import select
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+T0 = 1_600_000_000
+N_SAMPLES = 120
+N_INSTANCES = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(cfg, tmp_path, name):
+    cfg_path = tmp_path / f"{name}.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.server",
+         "--config", str(cfg_path)],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+
+
+def _wait_ready(proc, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    buf = b""
+    while time.monotonic() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if not r:
+            if proc.poll() is not None:
+                raise RuntimeError("server died during startup")
+            continue
+        ch = proc.stdout.read1(4096)
+        if not ch:
+            raise RuntimeError("stdout closed")
+        buf += ch
+        if b"\n" in buf:
+            return json.loads(buf.split(b"\n", 1)[0])
+    raise TimeoutError("no startup line")
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _poll(fn, timeout=90.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+            if ok:
+                return last
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"poll timed out; last={last!r}")
+
+
+def _series_instances(port):
+    """All heap_usage-family series visible via an unpruned query."""
+    # regex selector: unprunable (fans to all shards on both nodes) and
+    # double-typed only (no hist/double mixing in one vector)
+    body = _get(port, "/promql/timeseries/api/v1/query",
+                query='{_metric_=~"heap_usage|http_requests_total"}',
+                time=T0 + (N_SAMPLES - 1) * 10)
+    out = set()
+    for r in body["data"]["result"]:
+        m = r["metric"]
+        out.add((m.get("_metric_", m.get("__name__", "?")),
+                 m.get("instance", "")))
+    return out
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "seed-dev-data": True, "seed-start-ms": T0 * 1000,
+        "seed-samples": N_SAMPLES, "seed-instances": N_INSTANCES,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": 0.25,
+    }
+    procs = []
+    try:
+        procs.append(_spawn({**base, "node-ordinal": 0, "port": p0},
+                            tmp_path, "node0"))
+        procs.append(_spawn({**base, "node-ordinal": 1, "port": p1},
+                            tmp_path, "node1"))
+        for p in procs:
+            _wait_ready(p)
+        yield p0, p1, procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+def test_cross_node_query_and_peer_death(cluster):
+    p0, p1, procs = cluster
+
+    # each node owns half the shards; together they hold all seeded series
+    st0 = _poll(lambda: ((lambda b: (len(b["data"]) == 4, b))(
+        _get(p0, "/api/v1/cluster/timeseries/status"))))
+    nodes = {s["shard"]: s["address"] for s in st0["data"]}
+    assert set(nodes.values()) == {"node0", "node1"}
+
+    # both entry points see the SAME full series set (cross-node dispatch)
+    all0 = _poll(lambda: ((lambda s: (len(s) > 0, s))(
+        _series_instances(p0))))
+    all1 = _series_instances(p1)
+    assert all0 == all1
+
+    # /series metadata fans out to peers too
+    sb = _get(p0, "/promql/timeseries/api/v1/series",
+              **{"match[]": '{_metric_="heap_usage"}'})
+    insts = {m.get("instance") for m in sb["data"]}
+    assert insts == {m[1] for m in all0 if m[0] == "heap_usage"}
+
+    # the series set spans both nodes: each node alone (local shards only)
+    # holds a strict subset — verify via the raw leaf endpoint
+    def _local_count(port, shards):
+        body = json.dumps({"filters": [["_metric_", "re",
+                                        "heap_usage|http_requests_total"]],
+                           "start_ms": 0, "end_ms": 1 << 60,
+                           "column": None, "shards": shards}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/raw/timeseries", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return len(json.loads(r.read())["data"])
+
+    n_local0 = _local_count(p0, [0, 1])
+    n_local1 = _local_count(p1, [2, 3])
+    assert n_local0 + n_local1 == len(all0)
+    assert 0 < n_local0 < len(all0)
+
+    # rate() across nodes works end to end
+    body = _get(p0, "/promql/timeseries/api/v1/query_range",
+                query="rate(http_requests_total[5m])",
+                start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60)
+    assert len(body["data"]["result"]) == N_INSTANCES
+
+    # -- kill node1: survivor flips its shards DOWN, queries exclude ------
+    os.kill(procs[1].pid, signal.SIGKILL)
+    procs[1].wait(timeout=30)
+
+    def _down():
+        b = _get(p0, "/api/v1/cluster/timeseries/status")
+        down = {s["shard"] for s in b["data"] if s["status"] == "down"}
+        return down == {2, 3}, b
+    _poll(_down, timeout=30)
+
+    # queries now answer from the surviving shards only (no error)
+    partial = _series_instances(p0)
+    assert len(partial) == n_local0
+    assert partial < all0
+
+
+def test_peer_recovery_restores_shards(cluster, tmp_path):
+    p0, p1, procs = cluster
+    _poll(lambda: ((lambda s: (len(s) > 0, s))(_series_instances(p0))))
+    full = _series_instances(p0)
+    os.kill(procs[1].pid, signal.SIGKILL)
+    procs[1].wait(timeout=30)
+    _poll(lambda: ((lambda b: (any(
+        s["status"] == "down" for s in b["data"]), b))(
+        _get(p0, "/api/v1/cluster/timeseries/status"))), timeout=30)
+
+    # restart node1 on the same port: detector flips shards back ACTIVE
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    cfg = {"num-shards": 4, "num-nodes": 2, "node-ordinal": 1, "port": p1,
+           "peers": peers, "seed-dev-data": True,
+           "seed-start-ms": T0 * 1000, "seed-samples": N_SAMPLES,
+           "seed-instances": N_INSTANCES, "query-sample-limit": 0,
+           "query-series-limit": 0, "failure-detect-interval-s": 0.25}
+    procs[1] = _spawn(cfg, tmp_path, "node1b")
+    _wait_ready(procs[1])
+    _poll(lambda: ((lambda b: (all(
+        s["status"] == "active" for s in b["data"]), b))(
+        _get(p0, "/api/v1/cluster/timeseries/status"))), timeout=30)
+    _poll(lambda: ((lambda s: (s == full, s))(_series_instances(p0))))
