@@ -1,0 +1,80 @@
+"""Ingest benchmark: samples/sec through the single-shard ingest path +
+encode (flush) throughput + bytes/sample on the wire.
+
+Reference harness: jmh/src/main/scala/filodb.jmh/IngestionBenchmark.scala
+(ingestRecords: BinaryRecord containers -> TimeSeriesShard.ingest) and the
+~5 bytes/sample off-heap sizing rule (conf/timeseries-dev-source.conf).
+
+Prints ONE JSON line:
+  {"metric": "ingest_samples_per_s", "value": ..., "unit": "samples/s",
+   "encode_samples_per_s": ..., "bytes_per_sample": ..., "native": bool}
+"""
+
+import json
+import time
+
+import numpy as np
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.memory import nibblepack as nbp
+
+S = 200            # series
+N = 720            # samples/series (2h at 10s)
+T0 = 1_600_000_000_000
+
+
+def _containers():
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    rng = np.random.default_rng(7)
+    incs = rng.uniform(0.0, 5.0, (S, N))
+    vals = np.cumsum(incs, axis=1)
+    jit = rng.integers(-500, 500, (S, N))
+    for s in range(S):
+        labels = {"_metric_": "reqs_total", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": f"i{s}"}
+        ts_row = T0 + np.arange(N) * 10_000 + jit[s]
+        v_row = vals[s]
+        for t in range(N):
+            b.add_sample("prom-counter", labels, int(ts_row[t]),
+                         float(v_row[t]))
+    return b.containers()
+
+
+def main():
+    conts = _containers()
+    total = sum(len(c) for c in conts)
+
+    # ingest path: container -> partitions -> write buffers
+    shard = TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, 0,
+                            max_chunk_rows=400)
+    t0 = time.perf_counter()
+    for c in conts:
+        shard.ingest(c)
+    t_ingest = time.perf_counter() - t0
+
+    # encode path: write buffers -> immutable compressed chunks
+    t0 = time.perf_counter()
+    shard.flush_all()
+    t_encode = time.perf_counter() - t0
+
+    enc_bytes = 0
+    for part in shard.partitions.values():
+        for ch in part.chunks:
+            enc_bytes += sum(len(v) for v in ch.vectors)
+
+    out = {
+        "metric": "ingest_samples_per_s",
+        "value": round(total / t_ingest, 1),
+        "unit": "samples/s",
+        "encode_samples_per_s": round(total / t_encode, 1),
+        "bytes_per_sample": round(enc_bytes / total, 2),
+        "samples": total,
+        "native_codec": nbp._native is not None,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

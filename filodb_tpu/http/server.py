@@ -274,7 +274,8 @@ class FiloHttpServer:
         series = select_raw_series(
             subset, wire_to_filters(body.get("filters", [])),
             int(body["start_ms"]), int(body["end_ms"]),
-            body.get("column"), QueryStats(), full=True,
+            body.get("column"), QueryStats(),
+            full=bool(body.get("full", True)),
             limits=self.query_limits)
         return 200, {"status": "success", "data": series_to_wire(series)}
 
@@ -282,11 +283,12 @@ class FiloHttpServer:
         """Fan a labels/label-values request out to peers and union the
         results (metadata scatter-gather; MetadataRemoteExec
         equivalent)."""
-        import urllib.error
         import urllib.request as ureq
+        from concurrent.futures import ThreadPoolExecutor
         out: set = set()
         if qs.get("__local__"):
             return out
+        targets = []
         for node, base in self.peers.items():
             # the FailureDetector already marked dead peers' shards DOWN:
             # don't block metadata requests waiting on them
@@ -296,16 +298,22 @@ class FiloHttpServer:
                     continue
             q = dict(qs)
             q["__local__"] = ["1"]
-            url = (f"{base.rstrip('/')}/promql/{ds}/api/v1/{rest}?"
-                   + urllib.parse.urlencode(q, doseq=True))
+            targets.append(f"{base.rstrip('/')}/promql/{ds}/api/v1/{rest}?"
+                           + urllib.parse.urlencode(q, doseq=True))
+        if not targets:
+            return out
+
+        def fetch(url):
             try:
                 with ureq.urlopen(url, timeout=5) as r:
-                    payload = json.loads(r.read())
-                if payload.get("status") == "success":
-                    data = payload["data"]
+                    return json.loads(r.read())
+            except (OSError, ValueError):
+                return None     # down peers: partial metadata
+
+        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as ex:
+            for payload in ex.map(fetch, targets):
+                if payload and payload.get("status") == "success":
                     out.update(tuple(sorted(d.items()))
                                if isinstance(d, dict) else d
-                               for d in data)
-            except (OSError, ValueError):
-                continue        # down peers: partial metadata
+                               for d in payload["data"])
         return out

@@ -214,7 +214,7 @@ def pack_doubles(values, out: bytearray) -> None:
 
 
 def unpack_to_words(buf, pos: int, num_values: int):
-    """Unpack ``num_values`` raw u64 words; returns (list, new_pos)."""
+    """Unpack ``num_values`` raw u64 words; returns (u64 ndarray, new_pos)."""
     out = []
     group = [0] * 8
     left = num_values
@@ -223,7 +223,7 @@ def unpack_to_words(buf, pos: int, num_values: int):
         take = min(left, 8)
         out.extend(group[:take])
         left -= take
-    return out, pos
+    return np.array(out, dtype=np.uint64), pos
 
 
 def unpack_delta(buf, pos: int, num_values: int):
@@ -255,3 +255,97 @@ def unpack_double_xor(buf, pos: int, num_values: int):
         bits[1:] = np.bitwise_xor.accumulate(xors)
         bits[1:] ^= np.uint64(first_bits)
     return bits.view(np.float64).copy(), pos
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) fast path — same wire format, same signatures
+# ---------------------------------------------------------------------------
+# The Python functions above are the behavioral oracle (and the fallback
+# when no compiler exists); when the native codec builds, the public names
+# below are rebound to ctypes wrappers. Parity is pinned by
+# tests/test_nibblepack.py, which compares both implementations.
+
+pack_non_increasing_py = pack_non_increasing
+pack_delta_py = pack_delta
+pack_doubles_py = pack_doubles
+unpack_to_words_py = unpack_to_words
+unpack_delta_py = unpack_delta
+unpack_double_xor_py = unpack_double_xor
+
+try:
+    from filodb_tpu.native import load_nibblepack as _load_native
+    _native = _load_native()
+except Exception:       # pragma: no cover — build env without g++
+    _native = None
+
+if _native is not None:
+    import ctypes as _ct
+
+    _U8P = _ct.POINTER(_ct.c_uint8)
+    _U64P = _ct.POINTER(_ct.c_uint64)
+    _I64P = _ct.POINTER(_ct.c_int64)
+    _F64P = _ct.POINTER(_ct.c_double)
+
+    def _cap(n: int) -> int:
+        # worst case per 8-word group: 2 header + 64 payload bytes
+        return 8 + ((n + 7) // 8) * 66
+
+    def pack_non_increasing(values, out: bytearray) -> None:
+        arr = np.ascontiguousarray(np.asarray(values, dtype=np.uint64))
+        buf = np.empty(_cap(arr.size), dtype=np.uint8)
+        n = _native.np_pack_non_increasing(
+            arr.ctypes.data_as(_U64P), arr.size,
+            buf.ctypes.data_as(_U8P))
+        out.extend(buf[:n].tobytes())
+
+    def pack_delta(values, out: bytearray) -> None:
+        arr = np.ascontiguousarray(np.asarray(values, dtype=np.int64))
+        buf = np.empty(_cap(arr.size), dtype=np.uint8)
+        n = _native.np_pack_delta(
+            arr.ctypes.data_as(_I64P), arr.size,
+            buf.ctypes.data_as(_U8P))
+        out.extend(buf[:n].tobytes())
+
+    def pack_doubles(values, out: bytearray) -> None:
+        arr = np.ascontiguousarray(np.asarray(values, dtype=np.float64))
+        if arr.size == 0:
+            raise ValueError("pack_doubles requires at least one value")
+        buf = np.empty(_cap(arr.size), dtype=np.uint8)
+        n = _native.np_pack_doubles(
+            arr.ctypes.data_as(_F64P), arr.size,
+            buf.ctypes.data_as(_U8P))
+        out.extend(buf[:n].tobytes())
+
+    def _in_buf(buf) -> np.ndarray:
+        return np.frombuffer(buf, dtype=np.uint8) \
+            if not isinstance(buf, np.ndarray) else buf
+
+    def unpack_to_words(buf, pos: int, num_values: int):
+        b = _in_buf(buf)
+        out = np.empty(num_values, dtype=np.uint64)
+        new_pos = _native.np_unpack_words(
+            b.ctypes.data_as(_U8P), b.size, pos, num_values,
+            out.ctypes.data_as(_U64P))
+        if new_pos < 0:
+            raise InputTooShort()
+        return out, new_pos
+
+    def unpack_delta(buf, pos: int, num_values: int):
+        b = _in_buf(buf)
+        out = np.empty(num_values, dtype=np.int64)
+        new_pos = _native.np_unpack_delta(
+            b.ctypes.data_as(_U8P), b.size, pos, num_values,
+            out.ctypes.data_as(_I64P))
+        if new_pos < 0:
+            raise InputTooShort()
+        return out, new_pos
+
+    def unpack_double_xor(buf, pos: int, num_values: int):
+        b = _in_buf(buf)
+        out = np.empty(num_values, dtype=np.float64)
+        new_pos = _native.np_unpack_double_xor(
+            b.ctypes.data_as(_U8P), b.size, pos, num_values,
+            out.ctypes.data_as(_F64P))
+        if new_pos < 0:
+            raise InputTooShort()
+        return out, new_pos

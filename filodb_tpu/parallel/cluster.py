@@ -108,11 +108,13 @@ class RemoteShardGroup:
         self.shard_num = tuple(self.shard_nums)
 
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
-                  column: Optional[str]) -> List[RawSeries]:
+                  column: Optional[str],
+                  full: bool = True) -> List[RawSeries]:
         body = json.dumps({
             "filters": filters_to_wire(filters),
             "start_ms": int(start_ms), "end_ms": int(end_ms),
             "column": column, "shards": self.shard_nums,
+            "full": bool(full),
         }).encode()
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,

@@ -61,11 +61,15 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     for shard in shards:
         fetch_raw = getattr(shard, "fetch_raw", None)
         if fetch_raw is not None:       # RemoteShardGroup: peer dispatch
-            got = fetch_raw(filters, start_ms, end_ms, column)
+            got = fetch_raw(filters, start_ms, end_ms, column, full=full)
             for s in got:
                 if stats is not None:
                     stats.series_scanned += 1
-                    stats.samples_scanned += int(s.ts.size)
+                    # count the in-range samples, like the local branch —
+                    # a full fetch ships the whole retention for caching
+                    lo = int(np.searchsorted(s.ts, start_ms, side="left"))
+                    hi = int(np.searchsorted(s.ts, end_ms, side="right"))
+                    stats.samples_scanned += hi - lo
                     if limits is not None:
                         limits.check(stats)
             out.extend(got)

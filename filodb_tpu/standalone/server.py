@@ -185,10 +185,10 @@ class FiloServer:
         from filodb_tpu.ingest import IngestionDriver, LogIngestionStream
         stream_dir = self.config["stream-dir"]
         n = self.config["num-shards"]
-        for shard in range(n):
+        for shard in self.owned_shards:
             path = os.path.join(stream_dir, f"shard={shard}", "stream.log")
             self.streams[shard] = LogIngestionStream(path, DEFAULT_SCHEMAS)
-        for shard in range(n):
+        for shard in self.owned_shards:
             drv = IngestionDriver(
                 self.store.get_shard(self.ref, shard), self.streams[shard],
                 mapper=self.mapper,

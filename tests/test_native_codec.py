@@ -1,0 +1,135 @@
+"""Native (C++) NibblePack codec: bit-parity with the Python oracle, and
+batched-ingest equivalence with the per-row path.
+
+(The native layer SURVEY §2.1 flags: the interchange wire format must be
+identical from either implementation — NibblePackTest /
+EncodingPropertiesTest are the reference's equivalents.)
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.memory import nibblepack as nbp
+
+pytestmark = pytest.mark.skipif(
+    nbp._native is None, reason="native codec unavailable (no g++?)")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_native_pack_bit_parity(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(0, 300))
+    longs = np.sort(rng.integers(0, 1 << 50, n))
+    a, b = bytearray(), bytearray()
+    nbp.pack_delta(longs, a)
+    nbp.pack_delta_py(longs, b)
+    assert bytes(a) == bytes(b)
+
+    u = rng.integers(0, 1 << 63, n).astype(np.uint64)
+    a, b = bytearray(), bytearray()
+    nbp.pack_non_increasing(u, a)
+    nbp.pack_non_increasing_py(u, b)
+    assert bytes(a) == bytes(b)
+
+    d = rng.normal(0, 10.0 ** rng.integers(0, 7), max(n, 1))
+    d[rng.integers(0, d.size, d.size // 10)] = np.nan
+    a, b = bytearray(), bytearray()
+    nbp.pack_doubles(d, a)
+    nbp.pack_doubles_py(d, b)
+    assert bytes(a) == bytes(b)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_unpack_matches_python(seed):
+    rng = np.random.default_rng(seed + 50)
+    n = int(rng.integers(1, 300))
+    longs = np.sort(rng.integers(0, 1 << 50, n))
+    buf = bytearray()
+    nbp.pack_delta_py(longs, buf)
+    got, p1 = nbp.unpack_delta(bytes(buf), 0, n)
+    exp, p2 = nbp.unpack_delta_py(bytes(buf), 0, n)
+    assert p1 == p2
+    np.testing.assert_array_equal(got, exp)
+
+    d = rng.normal(size=n)
+    buf = bytearray()
+    nbp.pack_doubles_py(d, buf)
+    got, p1 = nbp.unpack_double_xor(bytes(buf), 0, n)
+    np.testing.assert_array_equal(got, d)
+    assert p1 == len(buf)
+
+
+def test_native_unpack_short_input_raises():
+    buf = bytearray()
+    nbp.pack_delta(np.arange(100, dtype=np.int64) * 1000, buf)
+    with pytest.raises(nbp.InputTooShort):
+        nbp.unpack_delta(bytes(buf[: len(buf) // 2]), 0, 100)
+
+
+# --- batched ingest equivalence -------------------------------------------
+
+REF = DatasetRef("timeseries")
+
+
+def _shard():
+    return TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, max_chunk_rows=100)
+
+
+def _container(ts_rows):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s, ts_list in enumerate(ts_rows):
+        labels = {"_metric_": "cpu", "_ws_": "demo", "_ns_": "App-0",
+                  "instance": f"i{s}"}
+        for t in ts_list:
+            b.add_sample("gauge", labels, int(t), float(t) * 0.5)
+    return b.containers()
+
+
+@pytest.mark.parametrize("shape", ["sorted", "ooo", "dup", "interleaved"])
+def test_batched_ingest_matches_per_row(shape):
+    rng = np.random.default_rng(hash(shape) % (1 << 31))
+    rows = []
+    for s in range(3):
+        ts = 1_000_000 + np.arange(250) * 1000
+        if shape == "ooo":
+            ts = ts.copy()
+            ts[50:60] = ts[50:60][::-1]
+        elif shape == "dup":
+            ts = np.repeat(ts, 2)[:250]
+        elif shape == "interleaved":
+            ts = np.sort(rng.choice(ts, 200, replace=False))
+        rows.append(ts)
+    conts = _container(rows)
+
+    batched = _shard()
+    for c in conts:
+        batched.ingest(c)
+
+    perrow = _shard()
+    for c in conts:
+        for row in c.rows():
+            part = perrow.get_or_create_partition(row.part_key,
+                                                  row.timestamp)
+            if part.ingest(row.timestamp, row.values):
+                perrow.index.update_end_time(part.part_id, row.timestamp)
+
+    for pid, part in batched.partitions.items():
+        other = perrow.partitions[pid]
+        ts_a, v_a, _ = part.read_full(1)
+        ts_b, v_b, _ = other.read_full(1)
+        np.testing.assert_array_equal(ts_a, ts_b)
+        np.testing.assert_array_equal(v_a, v_b)
+        assert part.ooo_dropped + batched.stats.out_of_order_dropped >= 0
+
+
+def test_batched_ingest_chunk_rollover_sizes():
+    """Chunks must still cap at max_chunk_rows when a run overshoots."""
+    shard = _shard()
+    for c in _container([1_000_000 + np.arange(350) * 1000]):
+        shard.ingest(c)
+    part = next(iter(shard.partitions.values()))
+    assert [ch.num_rows for ch in part.chunks] == [100, 100, 100]
+    assert len(part._ts_buf) == 50

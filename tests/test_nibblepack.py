@@ -95,7 +95,7 @@ def test_pack_unpack_non_increasing():
     out = bytearray()
     nbp.pack_non_increasing(inputs, out)
     got, _ = nbp.unpack_to_words(bytes(out), 0, len(inputs))
-    assert got == inputs
+    np.testing.assert_array_equal(got, np.array(inputs, dtype=np.uint64))
 
 
 @pytest.mark.parametrize("seed", range(5))
