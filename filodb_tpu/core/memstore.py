@@ -79,7 +79,8 @@ class TimeSeriesPartition:
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
                  "ingested", "ooo_dropped", "_decode_cache", "_merge_cache",
-                 "persisted_chunks", "odp_pending", "_cache_lock")
+                 "persisted_chunks", "odp_pending", "_cache_lock",
+                 "card_active")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -101,6 +102,7 @@ class TimeSeriesPartition:
         self._merge_cache: Dict[int, Tuple] = {}
         self.persisted_chunks = 0   # prefix of `chunks` already in the store
         self.odp_pending = False    # True: chunks live in the ColumnStore
+        self.card_active = True     # counted as active in the tracker
         # guards _decode_cache/_merge_cache population: concurrent HTTP
         # query threads share these caches (the chunk list itself is only
         # appended to, and readers work off a snapshot length)
@@ -402,6 +404,7 @@ class ShardStats:
     chunks_persisted: int = 0
     partitions_paged_in: int = 0    # ODP page-ins (ChunkSourceStats)
     partitions_bootstrapped: int = 0
+    quota_dropped_series: int = 0   # new series rejected by cardinality
 
 
 class TimeSeriesShard:
@@ -412,13 +415,16 @@ class TimeSeriesShard:
                  num_groups: int = 8,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
                  max_series: int = 1_000_000,
-                 column_store: Optional[object] = None):
+                 column_store: Optional[object] = None,
+                 card_tracker: Optional[object] = None):
         self.ref = ref
         self.schemas = schemas
         self.shard_num = shard_num
         self.num_groups = num_groups
         self.max_chunk_rows = max_chunk_rows
         self.max_series = max_series  # cardinality quota (ratelimit/)
+        # per-(ws,ns,metric) quota tree (ratelimit/CardinalityTracker)
+        self.card_tracker = card_tracker
         self.column_store = column_store  # ChunkSink/RawChunkSource boundary
         self.partitions: Dict[int, TimeSeriesPartition] = {}
         self._by_part_key: Dict[bytes, int] = {}
@@ -433,20 +439,36 @@ class TimeSeriesShard:
         self._odp_lock = threading.Lock()
 
     # -- ingest path ------------------------------------------------------
-    def get_or_create_partition(self, part_key: PartKey, first_ts: int
+    def get_or_create_partition(self, part_key: PartKey, first_ts: int,
+                                active: bool = True
                                 ) -> Optional[TimeSeriesPartition]:
-        """(TimeSeriesShard.scala:960 getOrAddPartitionForIngestion)."""
+        """(TimeSeriesShard.scala:960 getOrAddPartitionForIngestion).
+        ``active=False`` registers a recovered/bootstrapped shell that is
+        counted in cardinality totals but not as actively ingesting."""
         kb = part_key.to_bytes()
         pid = self._by_part_key.get(kb)
         if pid is not None:
             return self.partitions[pid]
         if len(self.partitions) >= self.max_series:
-            # quota breach: drop new series (ratelimit/CardinalityTracker)
+            # shard-wide cap breach: drop new series
+            self.stats.quota_dropped_series += 1
             return None
+        if self.card_tracker is not None:
+            from filodb_tpu.core.cardinality import QuotaReachedException
+            try:
+                self.card_tracker.modify_count(
+                    self.card_tracker.prefix_of(part_key.label_map), 1,
+                    1 if active else 0)
+            except QuotaReachedException:
+                # per-prefix quota breach: drop new series + stat
+                # (QuotaExceededProtocol)
+                self.stats.quota_dropped_series += 1
+                return None
         schema = self.schemas.by_id(part_key.schema_id)
         pid = self._next_part_id
         self._next_part_id += 1
         part = TimeSeriesPartition(pid, part_key, schema, self.max_chunk_rows)
+        part.card_active = active
         self.partitions[pid] = part
         self._by_part_key[kb] = pid
         self.index.add_part_key(pid, part_key.label_map, first_ts)
@@ -476,6 +498,12 @@ class TimeSeriesShard:
                 self.stats.rows_skipped += j - i
                 i = j
                 continue
+            if not part.card_active:
+                # resumed ingest into a recovered/evicted shell
+                part.card_active = True
+                if self.card_tracker is not None:
+                    self.card_tracker.modify_count(
+                        self.card_tracker.prefix_of(pk.label_map), 0, 1)
             if part.odp_pending:
                 # only page in when the run could overlap persisted history
                 # (replay — the OOO guard then sees it); normal continuation
@@ -567,7 +595,8 @@ class TimeSeriesShard:
         for e in self.column_store.scan_part_keys(self.ref.dataset,
                                                   self.shard_num):
             pk = PartKey.from_bytes(e.part_key)
-            part = self.get_or_create_partition(pk, e.start_ts)
+            part = self.get_or_create_partition(pk, e.start_ts,
+                                                active=False)
             if part is None:
                 continue
             part.odp_pending = True
@@ -672,10 +701,22 @@ class TimeSeriesShard:
             if entries:
                 self.column_store.write_part_keys(
                     self.ref.dataset, self.shard_num, entries)
+            for pid in evict:       # ODP shells: still counted, inactive
+                part = self.partitions[pid]
+                if part.card_active:
+                    part.card_active = False
+                    if self.card_tracker is not None:
+                        self.card_tracker.modify_count(
+                            self.card_tracker.prefix_of(
+                                part.part_key.label_map), 0, -1)
         else:
             for pid in evict:
                 part = self.partitions.pop(pid)
                 self._by_part_key.pop(part.part_key.to_bytes(), None)
+                if self.card_tracker is not None:
+                    self.card_tracker.modify_count(
+                        self.card_tracker.prefix_of(part.part_key.label_map),
+                        -1, -1 if part.card_active else 0)
             self.index.remove_part_keys(evict)
             self.stats.num_series = len(self.partitions)
         self.stats.partitions_evicted += len(evict)
@@ -695,7 +736,8 @@ class TimeSeriesMemStore:
 
     def setup(self, ref: DatasetRef, shard_num: int, num_groups: int = 8,
               max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
-              bootstrap: bool = False) -> TimeSeriesShard:
+              bootstrap: bool = False,
+              card_tracker: Optional[object] = None) -> TimeSeriesShard:
         """Create one shard; with ``bootstrap`` (and a column store) the tag
         index + checkpoints are recovered from persistence
         (TimeSeriesMemStore.scala setup + IndexBootstrapper on startup)."""
@@ -704,7 +746,8 @@ class TimeSeriesMemStore:
             raise ValueError(f"shard {shard_num} already set up for {ref}")
         shard = TimeSeriesShard(ref, self.schemas, shard_num, num_groups,
                                 max_chunk_rows,
-                                column_store=self.column_store)
+                                column_store=self.column_store,
+                                card_tracker=card_tracker)
         shards[shard_num] = shard
         if bootstrap:
             shard.bootstrap_from_store()

@@ -109,9 +109,14 @@ class FiloHttpServer:
             code, payload = 400, prom_json.error(str(e))
         except Exception as e:   # noqa: BLE001 — edge must not crash
             code, payload = 500, prom_json.error(str(e), "internal")
-        body = json.dumps(payload).encode()
+        if isinstance(payload, str):    # /metrics exposition text
+            body = payload.encode()
+            ctype = "text/plain; version=0.0.4"
+        else:
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
         req.send_response(code)
-        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Type", ctype)
         req.send_header("Content-Length", str(len(body)))
         req.end_headers()
         req.wfile.write(body)
@@ -119,12 +124,20 @@ class FiloHttpServer:
     def _route(self, path: str, qs: Dict, body_json=None):
         if path in ("/__health", "/__liveness", "/__readiness"):
             return 200, {"status": "healthy"}
+        if path == "/metrics":
+            return 200, self._metrics_text()
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
         if m:
             return 200, self._cluster_status(m.group("ds"))
         m = re.match(r"^/api/v1/raw/(?P<ds>[^/]+)$", path)
         if m:
             return self._raw_dispatch(m.group("ds"), body_json)
+        m = re.match(r"^/api/v1/cardinality/(?P<ds>[^/]+)$", path)
+        if m:
+            return self._cardinality(m.group("ds"), qs)
+        m = re.match(r"^/api/v1/cardinality-local/(?P<ds>[^/]+)$", path)
+        if m:
+            return self._cardinality(m.group("ds"), qs, local=True)
         m = _ROUTE.match(path)
         if not m:
             return 404, prom_json.error(f"no route for {path}", "not_found")
@@ -173,7 +186,9 @@ class FiloHttpServer:
         res = engine.execute(plan)
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
-        return 200, prom_json.matrix(res)
+        out = prom_json.matrix(res)
+        out["stats"] = self._query_stats(engine, res)
+        return 200, out
 
     def _query_instant(self, engine, qs):
         query = self._param(qs, "query")
@@ -184,7 +199,23 @@ class FiloHttpServer:
         res = engine.execute(plan)
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=True)
-        return 200, prom_json.vector(res)
+        out = prom_json.vector(res)
+        out["stats"] = self._query_stats(engine, res)
+        return 200, out
+
+    @staticmethod
+    def _query_stats(engine, res) -> Dict:
+        """Execution stats in the response (QueryStats threaded through
+        results, core/query/QueryContext.scala; Prom &stats=all shape)."""
+        st = engine.stats
+        nbytes = 0
+        if isinstance(res, GridResult):
+            nbytes = int(res.values.nbytes)
+            if res.hist_values is not None:
+                nbytes += int(res.hist_values.nbytes)
+        return {"seriesScanned": st.series_scanned,
+                "samplesScanned": st.samples_scanned,
+                "resultBytes": nbytes}
 
     def _time_range(self, qs):
         start = int(float(self._param(qs, "start", "0"))) * 1000
@@ -251,6 +282,109 @@ class FiloHttpServer:
                        "address": self.shard_mapper.node_of(i)}
                       for i in range(self.shard_mapper.num_shards)]
         return prom_json.success(states)
+
+    def _metrics_text(self) -> str:
+        """Prometheus exposition of shard/query/cache gauges — the
+        Kamon-metrics surface (TimeSeriesShardStats, TimeSeriesShard.scala:41;
+        MemoryStats; ChunkSourceStats; kamon prometheus reporter in
+        filodb-defaults.conf:1016)."""
+        import dataclasses as _dc
+        lines: List[str] = []
+
+        def emit(name, labels, value):
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            lines.append(f"filodb_{name}{{{lbl}}} {value}")
+
+        for ds, shards in self.shards_by_dataset.items():
+            for shard in shards:
+                st = getattr(shard, "stats", None)
+                if st is None:
+                    continue
+                labels = {"dataset": ds,
+                          "shard": str(getattr(shard, "shard_num", ""))}
+                for f in _dc.fields(st):
+                    emit(f.name, labels, getattr(st, f.name))
+                tracker = getattr(shard, "card_tracker", None)
+                if tracker is not None:
+                    root = tracker.scan((), 0)
+                    if root:
+                        emit("cardinality_total_series", labels,
+                             root[0].ts_count)
+                        emit("cardinality_active_series", labels,
+                             root[0].active_ts_count)
+        if self.shard_mapper is not None:
+            for i in range(self.shard_mapper.num_shards):
+                emit("shard_status", {
+                    "shard": str(i),
+                    "status": self.shard_mapper.status(i).value,
+                    "node": str(self.shard_mapper.node_of(i))}, 1)
+        if self.backend is not None:
+            emit("tile_cache_entries", {},
+                 len(getattr(self.backend, "_tile_cache", ())))
+            emit("tile_builds_total", {},
+                 getattr(self.backend, "tile_builds", 0))
+            emit("tile_cache_hits_total", {},
+                 getattr(self.backend, "tile_hits", 0))
+        return "\n".join(lines) + "\n"
+
+    def _cardinality(self, ds: str, qs: Dict, local: bool = False):
+        """GET /api/v1/cardinality/{ds}?prefix=ws,ns&depth=N — per-prefix
+        series counts from the cardinality trackers (TsCardinalities plan;
+        reference TsCardExec + TenantIngestionMetering surface)."""
+        shards = self.shards_by_dataset.get(ds)
+        if shards is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
+        raw_prefix = self._param(qs, "prefix", "") or ""
+        prefix = tuple(p for p in raw_prefix.split(",") if p)
+        try:
+            depth = int(self._param(qs, "depth",
+                                    str(min(len(prefix) + 1, 3))))
+        except ValueError:
+            raise QueryError("depth must be an integer")
+        if depth < len(prefix):
+            raise QueryError("depth must be >= prefix length")
+        recs = QueryEngine(shards).execute(
+            lp.TsCardinalities(prefix, depth))
+        if self.peers and not local:
+            # cross-node merge: peers answer their local counts
+            # (TsCardReduceExec scatter-gather)
+            from filodb_tpu.core.cardinality import (CardinalityRecord,
+                                                     merge_records)
+            remote = self._peer_cardinality(ds, qs)
+            recs = merge_records([recs] + [[
+                CardinalityRecord(tuple(d["prefix"]), d["tsCount"],
+                                  d["activeTsCount"], d["childrenCount"],
+                                  d["childrenQuota"])
+                for d in batch] for batch in remote])
+        return 200, prom_json.success([r.to_json() for r in recs])
+
+    def _peer_cardinality(self, ds: str, qs: Dict) -> List[List[Dict]]:
+        import urllib.request as ureq
+        from concurrent.futures import ThreadPoolExecutor
+        targets = []
+        for node, base in self.peers.items():
+            if self.shard_mapper is not None:
+                shards = self.shard_mapper.shards_for_node(node)
+                if shards and not self.shard_mapper.active_shards(shards):
+                    continue
+            targets.append(
+                f"{base.rstrip('/')}/api/v1/cardinality-local/{ds}?"
+                + urllib.parse.urlencode(qs, doseq=True))
+        if not targets:
+            return []
+
+        def fetch(url):
+            try:
+                with ureq.urlopen(url, timeout=5) as r:
+                    payload = json.loads(r.read())
+                if payload.get("status") == "success":
+                    return payload["data"]
+            except (OSError, ValueError):
+                pass
+            return []
+
+        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as ex:
+            return list(ex.map(fetch, targets))
 
     # -- cluster plane ----------------------------------------------------
     def _raw_dispatch(self, ds: str, body: Optional[Dict]):

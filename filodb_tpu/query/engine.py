@@ -880,6 +880,15 @@ class QueryEngine:
                         plan.filters, plan.start_ms, plan.end_ms):
                     out.append(dict(s.index.labels_for(pid)))
             return out
+        if isinstance(plan, lp.TsCardinalities):
+            from filodb_tpu.core.cardinality import merge_records
+            per = []
+            for s in local:
+                tracker = getattr(s, "card_tracker", None)
+                if tracker is not None:
+                    per.append(tracker.scan(plan.shard_key_prefix,
+                                            plan.num_groups))
+            return merge_records(per)
         return self._eval(plan)
 
     # -- vector evaluation ------------------------------------------------

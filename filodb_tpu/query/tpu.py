@@ -395,6 +395,7 @@ class TpuBackend:
         # threads (non-atomic FIFO evict could KeyError, inserts overshoot)
         self._tile_lock = threading.Lock()
         self.tile_builds = 0    # observability: device tile (re)builds
+        self.tile_hits = 0      # observability: cache hits
 
     def periodic_samples(self, series: Sequence[RawSeries],
                          params: RangeParams, function: str, window_ms: int,
@@ -479,6 +480,8 @@ class TpuBackend:
             key = tuple(id(s) for s in series)
         with self._tile_lock:
             entry = self._tile_cache.get(key)
+        if entry is not None:
+            self.tile_hits += 1
         if entry is None:
             prefix = [
                 RawSeries(s.labels, s.ts[:self._prefix_len(s)],

@@ -68,6 +68,11 @@ DEFAULTS = {
     "num-nodes": 1,
     "node-ordinal": 0,
     "peers": {},
+    # cardinality quotas (ratelimit QuotaSource, filodb-defaults.conf:277):
+    # default quota per prefix depth [root, ws, ns, metric]; 0 = unlimited.
+    # Per-prefix overrides: {"ws,ns": quota}. Breaches drop new series.
+    "card-default-quotas": [0, 0, 0, 0],
+    "card-quotas": {},
     "failure-detect-interval-s": 0.5,
     "failure-detect-threshold": 3,
 }
@@ -104,11 +109,21 @@ class FiloServer:
         else:
             self.node_id = self.config["node-id"]
             self.owned_shards = list(range(n))
+        from filodb_tpu.core.cardinality import CardinalityTracker
+        self.card_trackers = {}
         for shard in self.owned_shards:
+            tracker = CardinalityTracker(
+                tuple(self.config.get("card-default-quotas", ())))
+            for pfx, quota in dict(
+                    self.config.get("card-quotas") or {}).items():
+                tracker.set_quota([p for p in pfx.split(",") if p],
+                                  int(quota))
+            self.card_trackers[shard] = tracker
             self.store.setup(self.ref, shard,
                              num_groups=self.config["groups-per-shard"],
                              max_chunk_rows=self.config["max-chunks-size"],
-                             bootstrap=self.store.column_store is not None)
+                             bootstrap=self.store.column_store is not None,
+                             card_tracker=tracker)
         if num_nodes > 1:
             for i in range(num_nodes):
                 for shard in shards_for_ordinal(i, num_nodes, n):

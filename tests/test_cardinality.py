@@ -1,0 +1,229 @@
+"""Cardinality subsystem: quota tree, enforcement at series creation,
+TsCardinalities through engine + HTTP.
+
+(ratelimit/CardinalityTracker.scala:38, CardinalityTrackerSpec;
+QuotaExceededProtocol: breach drops new series with a counted stat.)
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.cardinality import (CardinalityTracker,
+                                         QuotaReachedException,
+                                         merge_records)
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.engine import QueryEngine
+
+REF = DatasetRef("timeseries")
+T0 = 1_600_000_000_000
+
+
+def _labels(ws, ns, metric, inst):
+    return {"_ws_": ws, "_ns_": ns, "_metric_": metric, "instance": inst}
+
+
+def test_tracker_counts_all_levels():
+    t = CardinalityTracker()
+    for i in range(5):
+        t.modify_count(("demo", "App-0", "cpu"), 1, 1)
+    t.modify_count(("demo", "App-1", "mem"), 1, 1)
+    recs = {tuple(r.prefix): r for r in t.scan((), 1)}
+    assert recs[("demo",)].ts_count == 6
+    recs2 = {tuple(r.prefix): r for r in t.scan(("demo",), 2)}
+    assert recs2[("demo", "App-0")].ts_count == 5
+    assert recs2[("demo", "App-1")].ts_count == 1
+    assert t.scan((), 0)[0].ts_count == 6
+
+
+def test_quota_enforced_at_any_level():
+    t = CardinalityTracker()
+    t.set_quota(["demo", "App-0"], 3)
+    for i in range(3):
+        t.modify_count(("demo", "App-0", f"m{i}"), 1, 1)
+    with pytest.raises(QuotaReachedException):
+        t.modify_count(("demo", "App-0", "m9"), 1, 1)
+    # sibling namespace unaffected
+    t.modify_count(("demo", "App-1", "m0"), 1, 1)
+    # release one, then admission works again
+    t.modify_count(("demo", "App-0", "m0"), -1, -1)
+    t.modify_count(("demo", "App-0", "m9"), 1, 1)
+
+
+def test_default_quota_by_depth():
+    t = CardinalityTracker(default_quotas=(0, 0, 2, 0))
+    t.modify_count(("demo", "ns1", "a"), 1)
+    t.modify_count(("demo", "ns1", "b"), 1)
+    with pytest.raises(QuotaReachedException):
+        t.modify_count(("demo", "ns1", "c"), 1)
+
+
+def test_top_k():
+    t = CardinalityTracker()
+    for i, n in enumerate([5, 1, 3]):
+        for _ in range(n):
+            t.modify_count(("demo", f"ns{i}", "m"), 1)
+    top = t.top_k(("demo",), 2)
+    assert [r.prefix[-1] for r in top] == ["ns0", "ns2"]
+    assert [r.ts_count for r in top] == [5, 3]
+
+
+def test_shard_drops_series_on_quota_breach():
+    tracker = CardinalityTracker()
+    tracker.set_quota(["demo", "App-0"], 2)
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0,
+                            card_tracker=tracker)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for i in range(4):
+        b.add_sample("gauge", _labels("demo", "App-0", "cpu", f"i{i}"),
+                     T0 + i, float(i))
+        # another tenant is not affected by App-0's quota
+        b.add_sample("gauge", _labels("demo", "App-1", "cpu", f"i{i}"),
+                     T0 + i, float(i))
+    for c in b.containers():
+        shard.ingest(c)
+    assert shard.stats.num_series == 6          # 2 App-0 + 4 App-1
+    assert shard.stats.quota_dropped_series == 2
+    recs = {tuple(r.prefix): r for r in tracker.scan(("demo",), 2)}
+    assert recs[("demo", "App-0")].ts_count == 2
+    assert recs[("demo", "App-1")].ts_count == 4
+
+
+def test_ts_cardinalities_through_engine():
+    shards = []
+    for sn in range(2):
+        tracker = CardinalityTracker()
+        shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, sn,
+                                card_tracker=tracker)
+        b = RecordBuilder(DEFAULT_SCHEMAS)
+        for i in range(3 + sn):
+            b.add_sample("gauge", _labels("demo", "App-0", "cpu", f"i{i}"),
+                         T0, 1.0)
+        for c in b.containers():
+            shard.ingest(c)
+        shards.append(shard)
+    recs = QueryEngine(shards).execute(lp.TsCardinalities(("demo",), 2))
+    assert len(recs) == 1
+    assert recs[0].prefix == ("demo", "App-0")
+    assert recs[0].ts_count == 7                # 3 + 4 across shards
+
+
+def test_cardinality_http_endpoint():
+    from filodb_tpu.standalone.server import FiloServer
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "card-quotas": {"demo,App-0": 1000}}).start()
+    try:
+        srv.seed_dev_data(n_samples=10, n_instances=3,
+                          start_ms=T0)
+        url = (f"http://127.0.0.1:{srv.port}/api/v1/cardinality/"
+               f"timeseries?prefix=demo&depth=2")
+        body = json.loads(urllib.request.urlopen(url, timeout=30).read())
+        assert body["status"] == "success"
+        assert body["data"], body
+        rec = body["data"][0]
+        assert rec["prefix"][0] == "demo"
+        assert rec["tsCount"] > 0
+        # depth 3: per-metric counts
+        url3 = (f"http://127.0.0.1:{srv.port}/api/v1/cardinality/"
+                f"timeseries?depth=3")
+        body3 = json.loads(urllib.request.urlopen(url3, timeout=30).read())
+        metrics = {tuple(r["prefix"])[-1] for r in body3["data"]}
+        assert "heap_usage" in metrics
+    finally:
+        srv.stop()
+
+
+def test_rejected_series_do_not_grow_tree():
+    """Regression: a quota-rejected flood of distinct metrics must not
+    allocate tracker nodes."""
+    t = CardinalityTracker()
+    t.set_quota(["demo", "App-0"], 1)
+    t.modify_count(("demo", "App-0", "m0"), 1, 1)
+    for i in range(100):
+        with pytest.raises(QuotaReachedException):
+            t.modify_count(("demo", "App-0", f"flood{i}"), 1, 1)
+    node = t._node_at(("demo", "App-0"))
+    assert set(node.children) == {"m0"}
+
+
+def test_set_quota_intermediate_nodes_get_depth_defaults():
+    """Regression: an override at depth 2 must not wipe the depth-1
+    default quota of the intermediate node."""
+    t = CardinalityTracker(default_quotas=(0, 2, 0, 0))
+    t.set_quota(["demo", "App-0"], 50)
+    assert t._node_at(("demo",)).quota == 2
+    t.modify_count(("demo", "a", "m"), 1)
+    t.modify_count(("demo", "b", "m"), 1)
+    with pytest.raises(QuotaReachedException):
+        t.modify_count(("demo", "c", "m"), 1)   # ws-level default trips
+
+
+def test_active_count_lifecycle_with_eviction(tmp_path):
+    """Active counts survive evict -> page-in -> evict cycles and resume
+    on re-ingest (ODP shells are total-counted but inactive)."""
+    from filodb_tpu.store import FlatFileColumnStore
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    tracker = CardinalityTracker()
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                            card_tracker=tracker)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for i in range(3):
+        for t in range(5):
+            b.add_sample("gauge", _labels("demo", "App-0", "cpu", f"i{i}"),
+                         T0 + t * 1000, 1.0)
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all(offset=1)
+    root = tracker.scan((), 0)[0]
+    assert (root.ts_count, root.active_ts_count) == (3, 3)
+
+    shard.evict_partitions(cutoff_ts=T0 + 1 << 40)
+    root = tracker.scan((), 0)[0]
+    assert (root.ts_count, root.active_ts_count) == (3, 0)
+    # double eviction must not decrement again
+    shard.evict_partitions(cutoff_ts=T0 + 1 << 40)
+    assert tracker.scan((), 0)[0].active_ts_count == 0
+
+    # resumed ingest re-activates exactly once
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    b.add_sample("gauge", _labels("demo", "App-0", "cpu", "i0"),
+                 T0 + 10_000_000, 2.0)
+    for c in b.containers():
+        shard.ingest(c)
+    root = tracker.scan((), 0)[0]
+    assert (root.ts_count, root.active_ts_count) == (3, 1)
+
+
+def test_bootstrap_counts_total_not_active(tmp_path):
+    from filodb_tpu.store import FlatFileColumnStore
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for i in range(4):
+        b.add_sample("gauge", _labels("demo", "App-0", "cpu", f"i{i}"),
+                     T0, 1.0)
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all(offset=1)
+
+    tracker = CardinalityTracker()
+    shard2 = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                             card_tracker=tracker)
+    shard2.bootstrap_from_store()
+    root = tracker.scan((), 0)[0]
+    assert (root.ts_count, root.active_ts_count) == (4, 0)
+
+
+def test_merge_records():
+    a = CardinalityTracker()
+    b = CardinalityTracker()
+    a.modify_count(("w", "n", "m"), 2, 2)
+    b.modify_count(("w", "n", "m"), 3, 1)
+    out = merge_records([a.scan(("w",), 3), b.scan(("w",), 3)])
+    assert out[0].ts_count == 5 and out[0].active_ts_count == 3
