@@ -33,19 +33,34 @@ def error(message: str, error_type: str = "bad_data",
     return {"status": status, "errorType": error_type, "error": message}
 
 
-def matrix(grid: GridResult) -> Dict:
+def matrix(grid: GridResult, hist_wire: bool = False) -> Dict:
     """Range-query result as resultType=matrix; NaN steps are omitted
-    (Prometheus staleness: absent sample, not NaN)."""
+    (Prometheus staleness: absent sample, not NaN).
+
+    ``hist_wire`` (internal cluster dispatch only) attaches native
+    histogram rows as base64 [T, NB] blocks so a forwarded query keeps
+    bucket data that the plain text format cannot carry."""
     result: List[Dict] = []
     steps_s = grid.steps / 1000.0
     for i, key in enumerate(grid.keys):
         row = grid.values[i]
         ok = ~np.isnan(row)
-        if not ok.any():
-            continue
-        values = [[float(t), _fmt(v)]
-                  for t, v, o in zip(steps_s, row, ok) if o]
-        result.append({"metric": _metric(key), "values": values})
+        entry = None
+        if ok.any():
+            values = [[float(t), _fmt(v)]
+                      for t, v, o in zip(steps_s, row, ok) if o]
+            entry = {"metric": _metric(key), "values": values}
+        if hist_wire and grid.is_hist():
+            import base64
+            hv = np.ascontiguousarray(grid.hist_values[i],
+                                      dtype=np.float64)
+            entry = entry or {"metric": _metric(key), "values": []}
+            entry["hist"] = {
+                "les": [float(x) for x in np.asarray(grid.bucket_les)],
+                "values": base64.b64encode(hv.tobytes()).decode(),
+            }
+        if entry is not None:
+            result.append(entry)
     return success({"resultType": "matrix", "result": result})
 
 

@@ -145,6 +145,10 @@ class FiloHttpServer:
         shards = self.shards_by_dataset.get(ds)
         if shards is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
+        # dispatch=local: a forwarded query must evaluate on this node's
+        # shards only (no fan-back-out; loop prevention for pushdown)
+        peers = {} if self._param(qs, "dispatch") == "local" \
+            else self.peers
         engine = QueryPlanner(shards, backend=self.backend,
                               shard_mapper=self.shard_mapper,
                               mesh_executor=self.mesh_executor,
@@ -152,7 +156,7 @@ class FiloHttpServer:
                               ds_store=self.ds_store_by_dataset.get(ds),
                               raw_retention_ms=self.raw_retention_ms,
                               limits=self.query_limits,
-                              node_id=self.node_id, peers=self.peers,
+                              node_id=self.node_id, peers=peers,
                               dataset=ds)
         if rest == "query_range":
             return self._query_range(engine, qs)
@@ -186,7 +190,8 @@ class FiloHttpServer:
         res = engine.execute(plan)
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
-        out = prom_json.matrix(res)
+        out = prom_json.matrix(
+            res, hist_wire=bool(self._param(qs, "hist-wire")))
         out["stats"] = self._query_stats(engine, res)
         return 200, out
 
@@ -359,32 +364,9 @@ class FiloHttpServer:
         return 200, prom_json.success([r.to_json() for r in recs])
 
     def _peer_cardinality(self, ds: str, qs: Dict) -> List[List[Dict]]:
-        import urllib.request as ureq
-        from concurrent.futures import ThreadPoolExecutor
-        targets = []
-        for node, base in self.peers.items():
-            if self.shard_mapper is not None:
-                shards = self.shard_mapper.shards_for_node(node)
-                if shards and not self.shard_mapper.active_shards(shards):
-                    continue
-            targets.append(
-                f"{base.rstrip('/')}/api/v1/cardinality-local/{ds}?"
-                + urllib.parse.urlencode(qs, doseq=True))
-        if not targets:
-            return []
-
-        def fetch(url):
-            try:
-                with ureq.urlopen(url, timeout=5) as r:
-                    payload = json.loads(r.read())
-                if payload.get("status") == "success":
-                    return payload["data"]
-            except (OSError, ValueError):
-                pass
-            return []
-
-        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as ex:
-            return list(ex.map(fetch, targets))
+        targets = self._live_peer_urls(
+            "{base}/api/v1/cardinality-local/%s" % ds, qs)
+        return [p["data"] for p in self._fanout(targets)]
 
     # -- cluster plane ----------------------------------------------------
     def _raw_dispatch(self, ds: str, body: Optional[Dict]):
@@ -413,41 +395,53 @@ class FiloHttpServer:
             limits=self.query_limits)
         return 200, {"status": "success", "data": series_to_wire(series)}
 
-    def _peer_metadata_union(self, ds: str, rest: str, qs: Dict) -> set:
-        """Fan a labels/label-values request out to peers and union the
-        results (metadata scatter-gather; MetadataRemoteExec
-        equivalent)."""
-        import urllib.request as ureq
-        from concurrent.futures import ThreadPoolExecutor
-        out: set = set()
-        if qs.get("__local__"):
-            return out
+    def _live_peer_urls(self, path_fmt: str, qs: Dict) -> List[str]:
+        """URLs for peers whose shards are still queryable (dead peers are
+        skipped — the FailureDetector already marked them DOWN)."""
         targets = []
         for node, base in self.peers.items():
-            # the FailureDetector already marked dead peers' shards DOWN:
-            # don't block metadata requests waiting on them
             if self.shard_mapper is not None:
                 shards = self.shard_mapper.shards_for_node(node)
                 if shards and not self.shard_mapper.active_shards(shards):
                     continue
-            q = dict(qs)
-            q["__local__"] = ["1"]
-            targets.append(f"{base.rstrip('/')}/promql/{ds}/api/v1/{rest}?"
-                           + urllib.parse.urlencode(q, doseq=True))
+            targets.append(path_fmt.format(base=base.rstrip("/"))
+                           + "?" + urllib.parse.urlencode(qs, doseq=True))
+        return targets
+
+    @staticmethod
+    def _fanout(targets: List[str]) -> List[Dict]:
+        """Concurrent GETs; returns successful payloads only (down peers
+        yield partial results, matching the query path's semantics)."""
+        import urllib.request as ureq
+        from concurrent.futures import ThreadPoolExecutor
         if not targets:
-            return out
+            return []
 
         def fetch(url):
             try:
                 with ureq.urlopen(url, timeout=5) as r:
-                    return json.loads(r.read())
+                    payload = json.loads(r.read())
+                if payload.get("status") == "success":
+                    return payload
             except (OSError, ValueError):
-                return None     # down peers: partial metadata
+                pass
+            return None
 
         with ThreadPoolExecutor(max_workers=min(8, len(targets))) as ex:
-            for payload in ex.map(fetch, targets):
-                if payload and payload.get("status") == "success":
-                    out.update(tuple(sorted(d.items()))
-                               if isinstance(d, dict) else d
-                               for d in payload["data"])
+            return [p for p in ex.map(fetch, targets) if p]
+
+    def _peer_metadata_union(self, ds: str, rest: str, qs: Dict) -> set:
+        """Fan a labels/label-values request out to peers and union the
+        results (metadata scatter-gather; MetadataRemoteExec
+        equivalent)."""
+        out: set = set()
+        if qs.get("__local__"):
+            return out
+        q = dict(qs)
+        q["__local__"] = ["1"]
+        targets = self._live_peer_urls(
+            "{base}/promql/%s/api/v1/%s" % (ds, rest), q)
+        for payload in self._fanout(targets):
+            out.update(tuple(sorted(d.items())) if isinstance(d, dict)
+                       else d for d in payload["data"])
         return out

@@ -190,6 +190,138 @@ class MeshExecutor:
                          jnp.asarray(scalar, dtype=jnp.float64))
         return run
 
+
+    def _prepare_inputs(self, series_by_shard, params, func, window_ms,
+                        group_ids_by_shard, offset_ms):
+        """Shared packing/padding prologue for the windowed mesh entry
+        points: [G,S,N] tiles, padded gid table, step-grid scalars and the
+        static per-window sample bound."""
+        n_shard = self.mesh.shape["shard"]
+        n_time = self.mesh.shape["time"]
+        if len(series_by_shard) % n_shard:
+            raise ValueError("shard groups must divide mesh shard axis")
+        ts, vals, lens, _ = pack_sharded(series_by_shard,
+                                         drop_nan=(func != "last_sample"))
+        G, S, _ = ts.shape
+        gids = np.full((G, S), -1, dtype=np.int32)   # -1 marks padding rows
+        for g, row in enumerate(group_ids_by_shard):
+            gids[g, :len(row)] = row
+        steps = params.steps
+        T = steps.size
+        T_pad = -(-T // n_time) * n_time
+        step = np.int64(params.step_ms if T > 1 else 1)
+        w0e = np.int64(steps[0] - offset_ms)
+        w0s = np.int64(w0e - window_ms)
+        w_bound = 0
+        if func in _GATHER_FUNCS:
+            all_series = [s for row in series_by_shard for s in row]
+            w_bound = TpuBackend._window_sample_bound(
+                all_series, window_ms, ts.shape[2])
+        return (ts, vals, lens, gids, T, T_pad // n_time, step, w0s, w0e,
+                w_bound, S)
+
+    @functools.cached_property
+    def _step_topk(self):
+        mesh = self.mesh
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("func", "num_groups", "k", "bottom",
+                            "nsteps_local", "w_bound"))
+        def run(func, num_groups, k, bottom, nsteps_local, w_bound, ts,
+                vals, lens, gids, w0s, w0e, step, scalar):
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(P("shard", None, None), P("shard", None, None),
+                          P("shard", None), P("shard", None),
+                          P(), P(), P(), P()),
+                out_specs=(P(None, "time", None), P(None, "time", None)),
+                # outputs ARE shard-replicated (derived from an all_gather
+                # over 'shard') but the static checker can't prove it
+                check_vma=False)
+            def inner(ts, vals, lens, gids, w0s, w0e, step, sc):
+                gl, S, N = ts.shape
+                ts2, vals2 = ts.reshape(gl * S, N), vals.reshape(gl * S, N)
+                lens2, gids2 = lens.reshape(-1), gids.reshape(-1)
+                t_off = jax.lax.axis_index("time").astype(
+                    jnp.int64) * nsteps_local * step
+                if func in _GATHER_FUNCS:
+                    local = _window_gather(func, w_bound, ts2, vals2, lens2,
+                                           w0s + t_off, w0e + t_off, step,
+                                           nsteps_local, sc)
+                else:
+                    local = _window_endpoint(func, ts2, vals2, lens2,
+                                             w0s + t_off, w0e + t_off, step,
+                                             nsteps_local, sc)
+                # per-group per-step local top-k, then a cross-shard
+                # all_gather + re-top-k — the TopBottomK reduce tree as a
+                # collective (aggregator TopBottomKRowAggregator)
+                sign = -1.0 if bottom else 1.0
+                score = jnp.where(jnp.isnan(local), -jnp.inf, sign * local)
+                score = jnp.where((gids2 >= 0)[:, None], score, -jnp.inf)
+                dev = jax.lax.axis_index("shard").astype(jnp.int32)
+                row_ids = dev * (gl * S) + jnp.arange(gl * S,
+                                                      dtype=jnp.int32)
+                ong = gids2[None, :] == jnp.arange(num_groups)[:, None]
+                sc_g = jnp.where(ong[:, :, None], score[None, :, :],
+                                 -jnp.inf)              # [G, S_l, T_l]
+                sc_t = jnp.transpose(sc_g, (0, 2, 1))   # [G, T_l, S_l]
+                kk = min(k, sc_t.shape[-1])
+                top_v, top_i = jax.lax.top_k(sc_t, kk)
+                top_ids = row_ids[top_i]
+                if kk < k:
+                    pad = sc_t.shape[:2] + (k - kk,)
+                    top_v = jnp.concatenate(
+                        [top_v, jnp.full(pad, -jnp.inf)], -1)
+                    top_ids = jnp.concatenate(
+                        [top_ids, jnp.full(pad, -1, jnp.int32)], -1)
+                all_v = jax.lax.all_gather(top_v, "shard")
+                all_ids = jax.lax.all_gather(top_ids, "shard")
+                n_sh = all_v.shape[0]
+                cat_v = jnp.transpose(all_v, (1, 2, 0, 3)).reshape(
+                    num_groups, -1, n_sh * k)
+                cat_i = jnp.transpose(all_ids, (1, 2, 0, 3)).reshape(
+                    num_groups, -1, n_sh * k)
+                fin_v, slot = jax.lax.top_k(cat_v, k)   # [G, T_l, k]
+                fin_ids = jnp.take_along_axis(cat_i, slot, axis=-1)
+                ok = jnp.isfinite(fin_v)
+                return (jnp.where(ok, sign * fin_v, jnp.nan),
+                        jnp.where(ok, fin_ids, -1))
+            return inner(ts, vals, lens, gids,
+                         jnp.asarray(w0s, jnp.int64),
+                         jnp.asarray(w0e, jnp.int64),
+                         jnp.asarray(step, jnp.int64),
+                         jnp.asarray(scalar, dtype=jnp.float64))
+        return run
+
+    def window_topk(self,
+                    series_by_shard: Sequence[Sequence[RawSeries]],
+                    params: RangeParams,
+                    function: str,
+                    window_ms: int,
+                    k: int,
+                    bottom: bool,
+                    group_ids_by_shard: Sequence[Sequence[int]],
+                    num_groups: int,
+                    func_args: Sequence[float] = (),
+                    offset_ms: int = 0):
+        """topk/bottomk over the mesh. Returns (values [G, T, k],
+        row_ids [G, T, k], S_pad) — row_id // S_pad is the shard group,
+        row_id % S_pad the series index within it (-1 = empty slot)."""
+        func = function or "last_sample"
+        if params.steps.size == 0:
+            return (np.empty((num_groups, 0, k)),
+                    np.full((num_groups, 0, k), -1, np.int32), 1)
+        (ts, vals, lens, gids, T, t_local, step, w0s, w0e, w_bound,
+         S) = self._prepare_inputs(series_by_shard, params, func,
+                                   window_ms, group_ids_by_shard,
+                                   offset_ms)
+        out_v, out_i = self._step_topk(
+            func, num_groups, int(k), bool(bottom), t_local,
+            w_bound, ts, vals, lens, gids, w0s, w0e, step,
+            float(func_args[0]) if func_args else 0.0)
+        return np.asarray(out_v)[:, :T], np.asarray(out_i)[:, :T], S
+
     def window_aggregate(self,
                          series_by_shard: Sequence[Sequence[RawSeries]],
                          params: RangeParams,
@@ -203,34 +335,15 @@ class MeshExecutor:
         """Returns the [num_groups, T] aggregated grid."""
         if agg not in MESH_AGGS:
             raise ValueError(f"agg {agg} not mesh-executable")
-        n_shard = self.mesh.shape["shard"]
-        n_time = self.mesh.shape["time"]
-        if len(series_by_shard) % n_shard:
-            raise ValueError("shard groups must divide mesh shard axis")
         func = function or "last_sample"
         if params.steps.size == 0:
             return np.empty((num_groups, 0), dtype=np.float64)
-        ts, vals, lens, _ = pack_sharded(series_by_shard,
-                                         drop_nan=(func != "last_sample"))
-        G, S, _ = ts.shape
-        gids = np.full((G, S), -1, dtype=np.int32)   # -1 marks padding rows
-        for g, row in enumerate(group_ids_by_shard):
-            gids[g, :len(row)] = row
-        steps = params.steps
-        # pad the step count to a multiple of the time axis by extending the
-        # uniform grid (the tail is computed and discarded)
-        T = steps.size
-        T_pad = -(-T // n_time) * n_time
-        step = np.int64(params.step_ms if T > 1 else 1)
-        w0e = np.int64(steps[0] - offset_ms)
-        w0s = np.int64(w0e - window_ms)
-        w_bound = 0
-        if func in _GATHER_FUNCS:
-            all_series = [s for row in series_by_shard for s in row]
-            w_bound = TpuBackend._window_sample_bound(
-                all_series, window_ms, ts.shape[2])
+        (ts, vals, lens, gids, T, t_local, step, w0s, w0e, w_bound,
+         _) = self._prepare_inputs(series_by_shard, params, func,
+                                   window_ms, group_ids_by_shard,
+                                   offset_ms)
         out = self._step(func, agg, num_groups,
-                         T_pad // n_time, w_bound, ts, vals, lens, gids,
+                         t_local, w_bound, ts, vals, lens, gids,
                          w0s, w0e, step,
                          float(func_args[0]) if func_args else 0.0)
         return np.asarray(out)[:, :T]

@@ -315,10 +315,17 @@ def aggregate(grid: GridResult, op: str, params: Tuple = (),
             var = np.maximum(s2 / cnt - mean * mean, 0.0)
             out = var if op == "stdvar" else np.sqrt(var)
         elif op in ("topk", "bottomk"):
-            return _topk(grid, int(params[0]), gids, gkeys,
+            try:
+                k = int(params[0])
+            except (TypeError, ValueError, IndexError):
+                raise QueryError(f"{op} expects a numeric k parameter")
+            return _topk(grid, k, gids, gkeys,
                          bottom=(op == "bottomk"))
         elif op == "quantile":
-            q = float(params[0])
+            try:
+                q = float(params[0])
+            except (TypeError, ValueError, IndexError):
+                raise QueryError("quantile expects a numeric parameter")
             out = np.full((ng, T), np.nan)
             for g in range(ng):
                 sel = v[gids == g]  # [Sg, T]

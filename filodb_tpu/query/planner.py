@@ -252,6 +252,8 @@ class MeshAggregateExec(ExecPlan):
     (parallel/mesh.py MeshExecutor.window_aggregate)."""
     agg_op: str
     by: Tuple[str, ...]
+    without: Tuple[str, ...]
+    agg_params: Tuple
     function: str
     window_ms: int
     func_args: Tuple[float, ...]
@@ -288,15 +290,24 @@ class MeshAggregateExec(ExecPlan):
         # pad the shard list to a multiple of the mesh shard axis
         while len(series_by_shard) % n_mesh:
             series_by_shard.append([])
-        # global group table: by-labels value tuple -> group id; histogram
-        # buckets ride as extra group lanes (gid*nb + bucket) and fold back
-        # into a [G, T, NB] grid after the collective
+        # global group table: grouping-label tuple -> group id (`by` keeps
+        # the named labels, `without` drops its labels + metric, matching
+        # AggregateMapReduce grouping); histogram buckets ride as extra
+        # group lanes (gid*nb + bucket), folded back into [G, T, NB] after
+        # the collective
+        from filodb_tpu.query.engine import strip_metric
         group_keys: Dict[Tuple, int] = {}
         gids_by_shard: List[List[int]] = []
         for row in series_by_shard:
             gids = []
             for j, s in enumerate(row):
-                key = tuple((l, s.labels.get(l, "")) for l in self.by)
+                if self.without:
+                    k2 = strip_metric(s.labels)
+                    key = tuple(sorted((l, v) for l, v in k2.items()
+                                       if l not in self.without))
+                else:
+                    key = tuple((l, s.labels.get(l, ""))
+                                for l in self.by)
                 gid = group_keys.setdefault(key, len(group_keys))
                 gids.append(gid * nb + (j % nb) if nb > 1 else gid)
             gids_by_shard.append(gids)
@@ -304,6 +315,9 @@ class MeshAggregateExec(ExecPlan):
         if not group_keys:
             return GridResult(steps, [],
                               np.zeros((0, steps.size), dtype=np.float64))
+        if self.agg_op in ("topk", "bottomk"):
+            return self._execute_topk(series_by_shard, gids_by_shard,
+                                      len(group_keys), steps)
         out = self.mesh_executor.window_aggregate(
             series_by_shard, self.params, self.function, self.window_ms,
             self.agg_op, gids_by_shard, len(group_keys) * nb,
@@ -316,6 +330,32 @@ class MeshAggregateExec(ExecPlan):
                               np.full((len(keys), steps.size), np.nan),
                               hist_values=hv, bucket_les=self.hist_les)
         return GridResult(steps, keys, out)
+
+    def _execute_topk(self, series_by_shard, gids_by_shard, num_groups,
+                      steps) -> GridResult:
+        """Assemble per-series topk/bottomk output from the mesh kernel's
+        [G, T, k] winner values + row ids (TopBottomKRowAggregator present
+        semantics: union of winning series, NaN at non-winning steps)."""
+        vals, ids, s_pad = self.mesh_executor.window_topk(
+            series_by_shard, self.params, self.function, self.window_ms,
+            int(self.params_k), self.agg_op == "bottomk", gids_by_shard,
+            num_groups, func_args=self.func_args, offset_ms=self.offset_ms)
+        T = steps.size
+        mask = (ids >= 0) & ~np.isnan(vals)
+        sel = ids[mask]
+        uniq, inv = np.unique(sel, return_inverse=True)
+        out = np.full((uniq.size, T), np.nan)
+        _, t_idx, _ = np.nonzero(mask)
+        out[inv, t_idx] = vals[mask]
+        keys = []
+        for rid in uniq:
+            row = series_by_shard[rid // s_pad]
+            keys.append(dict(row[rid % s_pad].labels))
+        return GridResult(steps, keys, out)
+
+    @property
+    def params_k(self) -> float:
+        return self.agg_params[0] if self.agg_params else 0
 
     def _expand_hist(self, row: List) -> List:
         """Expand each histogram series into NB per-bucket pseudo-series.
@@ -494,11 +534,45 @@ class QueryPlanner:
         return self._materialize_raw(plan)
 
     def _materialize_raw(self, plan) -> ExecPlan:
+        pushed = self._try_remote_pushdown(plan)
+        if pushed is not None:
+            return pushed
         mesh_plan = self._try_mesh_lowering(plan)
         if mesh_plan is not None:
             return mesh_plan
         return LocalEngineExec(plan, self._resolve_shards(plan),
                                self.backend, self.stats, self.limits)
+
+    def _try_remote_pushdown(self, plan) -> Optional[ExecPlan]:
+        """Whole-query forwarding when EVERY pruned shard lives on ONE
+        peer node and the plan prints back to PromQL — this is also the
+        shard-aligned binary-join pushdown (SingleClusterPlanner.scala:649:
+        joins execute where the data is when both sides target the same
+        shards; here "where the data is" is the owning peer)."""
+        if not self.peers or self.mapper is None:
+            return None
+        if lp.is_metadata_plan(plan) or lp.is_scalar_plan(plan):
+            return None
+        shards = self._resolve_shards(plan)
+        if not shards or not all(hasattr(s, "fetch_raw") for s in shards):
+            return None
+        nodes = {s.node_id for s in shards}
+        if len(nodes) != 1:
+            return None
+        rng = plan_range(plan)
+        if rng is None:
+            return None
+        start, step, end, _, _ = rng
+        if start % 1000 or end % 1000 or (step > 0 and step % 1000):
+            return None     # the HTTP edge carries second granularity
+        from filodb_tpu.query.planparser import plan_to_promql
+        query = plan_to_promql(plan)
+        if query is None:
+            return None
+        from filodb_tpu.parallel.cluster import PromQlRemoteExec
+        g = shards[0]
+        return PromQlRemoteExec(query, start, step, end, g.node_id,
+                                g.base_url, g.dataset)
 
     def execute(self, plan):
         return self.materialize(plan).execute()
@@ -594,10 +668,22 @@ class QueryPlanner:
 
         if self.mesh is None:
             return None
-        if not isinstance(plan, lp.Aggregate) or plan.op not in _MESH_AGGS:
+        topk = plan.op in ("topk", "bottomk") if isinstance(
+            plan, lp.Aggregate) else False
+        if not isinstance(plan, lp.Aggregate) or \
+                (plan.op not in _MESH_AGGS and not topk):
             return None
-        if plan.without or plan.params:
+        if plan.params and not topk:
             return None
+        if topk:
+            try:
+                k_ok = (len(plan.params) == 1
+                        and float(plan.params[0]).is_integer()
+                        and int(plan.params[0]) >= 1)
+            except (TypeError, ValueError):
+                k_ok = False
+            if not k_ok:
+                return None
         inner = plan.inner
         if not isinstance(inner, lp.PeriodicSeriesWithWindowing):
             return None
@@ -626,8 +712,12 @@ class QueryPlanner:
                 return None
             if hist_les is None:
                 return None
+        if topk and hist_kind != "none":
+            return None
         return MeshAggregateExec(
-            agg_op=plan.op, by=tuple(plan.by), function=inner.function,
+            agg_op=plan.op, by=tuple(plan.by),
+            without=tuple(plan.without), agg_params=tuple(plan.params),
+            function=inner.function,
             window_ms=inner.window_ms, func_args=tuple(inner.func_args),
             offset_ms=inner.offset_ms,
             params=RangeParams(inner.start_ms, inner.step_ms, inner.end_ms),

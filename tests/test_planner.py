@@ -124,11 +124,15 @@ def test_mesh_lowering_shape(cluster, mesh8):
     mat = planner.materialize(_plan(
         "sum(rate(http_requests_total[5m])) by (instance)"))
     assert isinstance(mat, MeshAggregateExec)
-    # non-lowerable shapes stay local
+    # topk/bottomk and `without` grouping lower onto the mesh too
     for q in ["topk(2, rate(http_requests_total[5m]))",
-              "sum(rate(http_requests_total[5m])) without (instance)",
-              "rate(http_requests_total[5m])",
-              "sum(abs(heap_usage))"]:
+              "sum(rate(http_requests_total[5m])) without (instance)"]:
+        assert isinstance(planner.materialize(_plan(q)),
+                          MeshAggregateExec), q
+    # non-lowerable shapes stay local
+    for q in ["rate(http_requests_total[5m])",
+              "sum(abs(heap_usage))",
+              "quantile(0.5, rate(http_requests_total[5m]))"]:
         assert isinstance(planner.materialize(_plan(q)), LocalEngineExec), q
 
 
@@ -139,6 +143,10 @@ def test_mesh_lowering_shape(cluster, mesh8):
     "count(delta(heap_usage[5m])) by (instance)",
     "avg(sum_over_time(heap_usage[2m])) by (instance)",
     'min(max_over_time(heap_usage{_ws_="demo",_ns_="App-0"}[5m]))',
+    "sum(rate(http_requests_total[5m])) without (instance)",
+    "topk(2, rate(http_requests_total[5m]))",
+    "bottomk(1, rate(http_requests_total[5m]))",
+    "topk(2, sum_over_time(heap_usage[2m])) by (instance)",
 ])
 def test_mesh_execution_matches_oracle(cluster, mesh8, q):
     store, mapper = cluster
