@@ -1,18 +1,31 @@
-"""Benchmark: PromQL `sum(rate(counter[5m])) by (job)` samples-scanned/sec
-on device (the BASELINE.json north-star workload, promperf shape —
-reference harness: jmh/src/main/scala/filodb.jmh/QueryInMemoryBenchmark.scala,
-which also measures queries over a WARM in-memory store).
+"""Benchmark: PromQL `sum(rate(counter[5m])) by (job)` on device — the
+BASELINE.json north-star workload at a scaled shape.
 
-Path measured: the aligned device tile store (filodb_tpu.query.tilestore) —
-pack-time prefix/fill precomputation, query-time shared-column selection +
-extrapolated-rate epilogue + grouped MXU aggregation, all one XLA program.
+Shape: 65,536 series x 8h at 10s scrape (2,880 samples) = 188.7M samples
+resident as aligned device tiles; the query grid covers the whole span
+(475 steps at 60s, 5m windows). This is 1/57th of the full north star
+(10M series x 24h on v5e-8); the printed extrapolation states what the
+measured per-chip throughput implies for that target.
 
-Timing notes: the axon tunnel adds ~0.1s per host sync and transfers at
-~27 MB/s, so K queries (shifted step grids) are chained inside one program
-with a tiny [G, T] output, the sync floor is subtracted, and the cost is
-amortized. Prints ONE JSON line. vs_baseline = device throughput / numpy
-oracle (CPU reference path) throughput, since the reference publishes no
-absolute numbers (BASELINE.md).
+Path measured: the production tilestore fast path —
+`tilestore.evaluate_counters_t` (slot-major [N,S] tiles: each step's
+boundary reads are contiguous rows; exact f64 numerics, parity-pinned by
+tests/test_tilestore.py) + group-contiguous reshape-sum aggregation in
+f64 (exact; the planner orders series by group id host-side).
+
+Honesty notes:
+- Data is generated ON DEVICE (the axon tunnel moves ~27 MB/s; shipping
+  3 GB of tiles would swamp the measurement). Tile build + compile are
+  excluded (warm store, like the reference's QueryInMemoryBenchmark
+  which also measures a warm in-memory store).
+- K queries with shifted step grids are chained in one program and the
+  empirical host-sync floor is subtracted, because one tunnel roundtrip
+  (~0.1s) would otherwise dominate a ~10ms query.
+- `vs_baseline` divides by a BATCHED numpy oracle (the same aligned
+  prefix-sum/boundary algorithm vectorized over a 8,192-series
+  subsample, no per-series Python loop), not an interpreter-bound loop.
+
+Prints ONE JSON line.
 """
 
 import json
@@ -26,12 +39,14 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
-S, N, T = 65_536, 512, 180
-N_GROUPS = 16
+S = 65_536          # series
+N = 2_880           # slots = 8h at 10s
 DT = 10_000
 WINDOW = 300_000
 STEP = 60_000
-K = 20
+N_GROUPS = 16
+K = 8               # chained shifted-grid queries
+BASE = 1_600_000_000_000
 
 
 def _timed(fn):
@@ -40,73 +55,157 @@ def _timed(fn):
     return time.perf_counter() - t0
 
 
-def _gen(seed=42):
-    rng = np.random.default_rng(seed)
-    ts = np.sort((np.arange(1, N + 1, dtype=np.int64) * DT)[None, :]
-                 + rng.integers(-2000, 2000, (S, N)), axis=1)
-    vals = np.cumsum(rng.uniform(0.0, 5.0, (S, N)), axis=1)
-    return ts, vals
+def _gen_device():
+    """Tiles generated on device: jittered timestamps + counter values."""
+    key = jax.random.PRNGKey(42)
+    k1, k2 = jax.random.split(key)
+
+    @jax.jit
+    def gen():
+        jit_ms = jax.random.uniform(k1, (S, N), dtype=jnp.float64,
+                                    minval=-2000, maxval=2000)
+        ts = BASE + jnp.arange(N, dtype=jnp.float64)[None, :] * DT + jit_ms
+        incs = jax.random.uniform(k2, (S, N), dtype=jnp.float64,
+                                  minval=0.0, maxval=5.0)
+        vals = jnp.cumsum(incs, axis=1)
+        return ts, vals
+    ts, vals = gen()
+    return jax.block_until_ready(ts), jax.block_until_ready(vals)
 
 
 def main():
     from filodb_tpu.query import tilestore as tst
 
-    ts, vals = _gen()
-    tiles = tst.AlignedTiles([{} for _ in range(S)], DT, DT,
-                             np.ones((S, N), bool),
-                             ts.astype(np.float64), vals)
-    arrs = tst._tiles_arrays(tiles, "rate")
-    gids = jnp.asarray((np.arange(S) % N_GROUPS).astype(np.int32))
+    ts, vals = _gen_device()
+    tiles = tst.AlignedTiles([{} for _ in range(S)], BASE, DT,
+                             np.ones((S, N), bool), ts, vals)
+    del ts, vals
+    # warm the transposed channels (tile-store pack time, excluded like
+    # the reference's warm store); drop the row-major intermediates so
+    # only the two [N, S] query tiles stay resident (~3 GB)
+    arrs = tst._tiles_arrays_t(tiles, "rate")
+    for a in arrs.values():
+        a.block_until_ready()
+    tiles._channels.clear()
+    tiles._ps.clear()
+    tiles.ts = tiles.vals = tiles.valid = None
+    consts = tuple(jnp.asarray(np.int64(v))
+                   for v in (tiles.num_slots, tiles.base_ms, tiles.dt_ms))
 
-    consts = tuple(jnp.asarray(np.int64(v)) for v in
-                   (tiles.num_slots, tiles.base_ms, tiles.dt_ms))
+    T = (N * DT - WINDOW) // STEP           # grid covers the whole span
+    SG = S // N_GROUPS                      # group-contiguous series
 
     @jax.jit
-    def many(arrs, gids, w0s, w0e, step):
-        onehot = (gids[:, None] == jnp.arange(N_GROUPS)[None, :]
-                  ).astype(jnp.float64)
+    def many(arrs, w0s, w0e, step):
         acc = jnp.zeros((N_GROUPS, T))
         for k in range(K):
-            local = tst._eval_core("rate", T, arrs, *consts,
-                                   w0s + k * 1000, w0e + k * 1000, step)
+            local = tst._eval_counter_t("rate", T, arrs, *consts,
+                                        w0s + k * 1000, w0e + k * 1000,
+                                        step)                   # [T, S]
             ok = ~jnp.isnan(local)
-            acc = acc + jnp.where(
-                onehot.T @ ok.astype(jnp.float64) > 0,
-                onehot.T @ jnp.where(ok, local, 0.0), 0.0)
+            v = jnp.where(ok, local, 0.0)
+            gsum = v.reshape(T, N_GROUPS, SG).sum(axis=2)       # [T, G]
+            gcnt = ok.reshape(T, N_GROUPS, SG).sum(axis=2)
+            acc = acc + jnp.where(gcnt > 0, gsum, 0.0).T
         return acc
 
-    # empirical host-sync floor: a trivial program with the same output
-    # shape (the axon tunnel adds ~0.1s RTT; locally this is ~0)
-    noop = jax.jit(lambda g: jnp.zeros((N_GROUPS, T)) + g[0])
-    np.asarray(noop(gids))
-    floor = min(_timed(lambda: np.asarray(noop(gids))) for _ in range(3))
+    noop = jax.jit(lambda x: jnp.zeros((N_GROUPS, T)) + x)
+    np.asarray(noop(jnp.float64(0)))
+    floor = min(_timed(lambda: np.asarray(noop(jnp.float64(i))))
+                for i in range(3))
 
-    args = (jnp.asarray(np.int64(0)), jnp.asarray(np.int64(WINDOW)),
+    args = (jnp.asarray(np.int64(BASE + WINDOW)),
+            jnp.asarray(np.int64(BASE + WINDOW)),
             jnp.asarray(np.int64(STEP)))
-    np.asarray(many(arrs, gids, *args))          # compile + pack warm
-    best = float("inf")
-    for _ in range(3):
-        best = min(best, _timed(lambda: np.asarray(many(arrs, gids, *args))))
-    per_query = max(best - min(floor, best * 0.5), best * 0.05) / K
-    device_sps = S * N / per_query
+    np.asarray(many(arrs, *args))           # compile
+    runs = []
+    for _ in range(5):
+        t = _timed(lambda: np.asarray(many(arrs, *args)))
+        runs.append(max(t - min(floor, t * 0.5), t * 0.05) / K)
+    per_query_p50 = float(np.median(runs))
+    device_sps = S * N / per_query_p50
 
-    # CPU numpy-oracle on a subsample, extrapolated (reference exec path)
-    from filodb_tpu.query import rangefn as rf
-    S_cpu = 512
+    # bytes the evaluator actually reads per query on the dense path:
+    # 10 row-takes of [T, S] f64 (6 of ts, 4 of the value tile)
+    touched = 10 * T * S * 8
+    hbm_gbps = touched / per_query_p50 / 1e9
+
+    # batched numpy oracle (same algorithm, vectorized, subsampled)
+    S_cpu = 8_192
+    ts_h = np.asarray(arrs["ts"].T[:S_cpu])
+    vals_raw = _gen_vals_host(S_cpu)
+    vals_h = vals_raw
     t0 = time.perf_counter()
-    acc = np.zeros(T)
-    for i in range(S_cpu):
-        row = rf.evaluate("rate", ts[i], vals[i], WINDOW, STEP,
-                          WINDOW + (T - 1) * STEP, WINDOW)
-        acc += np.where(np.isnan(row), 0.0, row)
+    _oracle_batched(ts_h, vals_h, T)
     oracle_sps = S_cpu * N / (time.perf_counter() - t0)
+
+    full_series = 10_000_000
+    full_samples = full_series * 8_640      # 24h at 10s
+    chips = 8
+    est_full_ms = full_samples / chips / device_sps * 1000.0
 
     print(json.dumps({
         "metric": "rate_sum_by_samples_scanned_per_sec",
         "value": round(device_sps),
         "unit": "samples/s",
         "vs_baseline": round(device_sps / oracle_sps, 2),
+        "per_query_p50_ms": round(per_query_p50 * 1000, 2),
+        "shape": f"{S}x{N} (8h@10s), T={T}, window=5m",
+        "hbm_read_gbps": round(hbm_gbps, 1),
+        "northstar_est_ms_v5e8": round(est_full_ms, 1),
     }))
+
+
+def _gen_vals_host(s_cpu):
+    """Regenerate the first s_cpu series' RAW values host-side for the
+    oracle (the device tiles hold the reset-corrected channel)."""
+    key = jax.random.PRNGKey(42)
+    _, k2 = jax.random.split(key)
+    incs = jax.random.uniform(k2, (S, N), dtype=jnp.float64,
+                              minval=0.0, maxval=5.0)[:s_cpu]
+    return np.cumsum(np.asarray(incs), axis=1)
+
+
+def _oracle_batched(ts, vals, T):
+    """Batched numpy rate + grouped sum: the aligned-slot algorithm with
+    fancy indexing — no per-series Python loop."""
+    Sb, Nb = vals.shape
+    prev = np.concatenate([np.full((Sb, 1), np.nan), vals[:, :-1]], axis=1)
+    drop = vals < prev
+    cv = vals + np.cumsum(np.where(drop, prev, 0.0), axis=1)
+    ps = np.concatenate([np.zeros((Sb, 1)), np.cumsum(
+        np.ones_like(vals), axis=1)], axis=1)
+    t = np.arange(T, dtype=np.int64)
+    wend = BASE + WINDOW + t * STEP
+    wstart = wend - WINDOW
+    k_hi = np.floor((wend - BASE + DT / 2.0) / DT).astype(np.int64)
+    k_lo = np.ceil((wstart - BASE - DT / 2.0) / DT).astype(np.int64)
+    khc = np.clip(k_hi, 0, Nb - 1)
+    khp = np.clip(k_hi - 1, 0, Nb - 1)
+    klc = np.clip(k_lo, 0, Nb - 1)
+    kln = np.clip(k_lo + 1, 0, Nb - 1)
+    cnt = ps[:, np.clip(k_hi, -1, Nb - 1) + 1] - ps[:, np.clip(k_lo, 0, Nb)]
+    cnt -= (ts[:, khc] > wend[None, :])
+    cnt -= (ts[:, klc] < wstart[None, :])
+    use1 = ts[:, khc] <= wend[None, :]
+    t2 = np.where(use1, ts[:, khc], ts[:, khp])
+    v2 = np.where(use1, cv[:, khc], cv[:, khp])
+    useb = ts[:, klc] >= wstart[None, :]
+    t1 = np.where(useb, ts[:, klc], ts[:, kln])
+    v1 = np.where(useb, cv[:, klc], cv[:, kln])
+    sampled = (t2 - t1) / 1000.0
+    delta = v2 - v1
+    with np.errstate(all="ignore"):
+        avg = sampled / (cnt - 1.0)
+        ds = np.minimum((t1 - wstart[None, :]) / 1000.0,
+                        np.where(delta > 0, sampled * v1 / delta, np.inf))
+        de = (wend[None, :] - t2) / 1000.0
+        ext = sampled + np.minimum(ds, avg * 1.1) + np.minimum(de, avg * 1.1)
+        rate = delta * (ext / sampled) / (WINDOW / 1000.0)
+        rate = np.where(cnt >= 2, rate, np.nan)
+    g = Sb // N_GROUPS
+    ok = ~np.isnan(rate)
+    return np.where(ok, rate, 0.0).reshape(N_GROUPS, g, T).sum(axis=1)
 
 
 if __name__ == "__main__":

@@ -82,6 +82,19 @@ def wire_to_series(rows: Sequence[Dict]) -> List[RawSeries]:
     return out
 
 
+def _get_json(url_or_req, node_id: str, timeout_s: float) -> Dict:
+    """Fetch + parse a peer response, mapping transport and peer errors to
+    QueryError (shared by leaf dispatch and whole-query forwarding)."""
+    try:
+        with urllib.request.urlopen(url_or_req, timeout=timeout_s) as r:
+            payload = json.loads(r.read())
+    except OSError as e:
+        raise QueryError(f"remote node {node_id} unreachable: {e}")
+    if payload.get("status") != "success":
+        raise QueryError(f"remote node {node_id}: {payload.get('error')}")
+    return payload
+
+
 def filters_to_wire(filters: Sequence[ColumnFilter]) -> List[List[str]]:
     return [[f.label, f.op, f.value] for f in filters]
 
@@ -119,15 +132,7 @@ class RemoteShardGroup:
         req = urllib.request.Request(
             f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,
             headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                payload = json.loads(r.read())
-        except OSError as e:
-            raise QueryError(
-                f"remote node {self.node_id} unreachable: {e}")
-        if payload.get("status") != "success":
-            raise QueryError(
-                f"remote node {self.node_id}: {payload.get('error')}")
+        payload = _get_json(req, self.node_id, self.timeout_s)
         return wire_to_series(payload["data"])
 
     # metadata plans are answered via the HTTP layer's peer fan-out, not
@@ -148,7 +153,7 @@ class PromQlRemoteExec:
 
     def __init__(self, query: str, start_ms: int, step_ms: int,
                  end_ms: int, node_id: str, base_url: str, dataset: str,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, stats=None):
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -157,6 +162,7 @@ class PromQlRemoteExec:
         self.base_url = base_url.rstrip("/")
         self.dataset = dataset
         self.timeout_s = timeout_s
+        self.stats = stats      # planner QueryStats: peer stats fold in
 
     def execute(self):
         import urllib.parse
@@ -177,15 +183,12 @@ class PromQlRemoteExec:
         qs["hist-wire"] = "1"
         url = (f"{self.base_url}/promql/{self.dataset}/api/v1/{path}?"
                + urllib.parse.urlencode(qs))
-        try:
-            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-                payload = json.loads(r.read())
-        except OSError as e:
-            raise QueryError(
-                f"remote node {self.node_id} unreachable: {e}")
-        if payload.get("status") != "success":
-            raise QueryError(
-                f"remote node {self.node_id}: {payload.get('error')}")
+        payload = _get_json(url, self.node_id, self.timeout_s)
+        if self.stats is not None and "stats" in payload:
+            self.stats.series_scanned += payload["stats"].get(
+                "seriesScanned", 0)
+            self.stats.samples_scanned += payload["stats"].get(
+                "samplesScanned", 0)
         data = payload["data"]
         keys, rows, hrows, les = [], [], [], None
         any_hist = False

@@ -572,7 +572,7 @@ class QueryPlanner:
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         g = shards[0]
         return PromQlRemoteExec(query, start, step, end, g.node_id,
-                                g.base_url, g.dataset)
+                                g.base_url, g.dataset, stats=self.stats)
 
     def execute(self, plan):
         return self.materialize(plan).execute()

@@ -10,9 +10,19 @@ from __future__ import annotations
 
 from typing import Optional
 
+import re
+
 from filodb_tpu.query import logical as lp
 
 _METRIC_LABELS = ("_metric_", "__name__")
+_IDENT = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _num(x) -> str:
+    """Full-precision numeric literal (repr round-trips f64 exactly;
+    %g's 6 digits would silently shift @ instants / thresholds)."""
+    f = float(x)
+    return str(int(f)) if f.is_integer() else repr(f)
 
 
 def _dur(ms: int) -> str:
@@ -39,7 +49,8 @@ def _selector(raw: lp.RawSeriesPlan, window_ms: Optional[int],
     metric = ""
     matchers = []
     for f in raw.filters:
-        if f.label in _METRIC_LABELS and f.op == "eq" and not metric:
+        if f.label in _METRIC_LABELS and f.op == "eq" and not metric \
+                and _IDENT.match(f.value):
             metric = f.value
             continue
         op = _OPS.get(f.op)
@@ -56,7 +67,7 @@ def _selector(raw: lp.RawSeriesPlan, window_ms: Optional[int],
     if offset_ms:
         s += f" offset {_dur(offset_ms)}"
     if at_ms is not None:
-        s += f" @ {at_ms / 1000:g}"
+        s += f" @ {_num(at_ms / 1000)}"
     return s
 
 
@@ -77,7 +88,7 @@ def _print(plan) -> Optional[str]:
                           plan.at_ms)
         if inner is None:
             return None
-        args = "".join(f"{a:g}, " for a in plan.func_args)
+        args = "".join(f"{_num(a)}, " for a in plan.func_args)
         return f"{plan.function}({args}{inner})"
     if isinstance(plan, lp.Aggregate):
         inner = _print(plan.inner)
@@ -89,7 +100,7 @@ def _print(plan) -> Optional[str]:
         elif plan.without:
             mod = f" without ({', '.join(plan.without)})"
         params = "".join(
-            (f"{_q(p)}, " if isinstance(p, str) else f"{p:g}, ")
+            (f"{_q(p)}, " if isinstance(p, str) else f"{_num(p)}, ")
             for p in plan.params)
         return f"{plan.op}({params}{inner}){mod}"
     if isinstance(plan, lp.BinaryJoin):
@@ -125,7 +136,7 @@ def _print(plan) -> Optional[str]:
         args = []
         for a in plan.func_args:
             s = _print(a) if not isinstance(a, (int, float)) \
-                else f"{a:g}"
+                else _num(a)
             if s is None:
                 return None
             args.append(s)
@@ -142,7 +153,7 @@ def _print(plan) -> Optional[str]:
         return None if inner is None else \
             (f"sort_desc({inner})" if plan.descending else f"sort({inner})")
     if isinstance(plan, lp.ScalarFixedDoublePlan):
-        return f"{plan.value:g}"
+        return _num(plan.value)
     if isinstance(plan, lp.ScalarTimeBasedPlan):
         return f"{plan.function}()"
     if isinstance(plan, lp.ScalarVaryingDoublePlan):

@@ -79,8 +79,13 @@ class AlignedTiles:
         self._ff: Dict[str, jnp.ndarray] = {}
         self._bf: Dict[str, jnp.ndarray] = {}
         self._ps: Dict[str, jnp.ndarray] = {}
+        self._tch: Dict[str, jnp.ndarray] = {}
+        self._tff: Dict[str, jnp.ndarray] = {}
+        self._tbf: Dict[str, jnp.ndarray] = {}
+        self._tps: Dict[str, jnp.ndarray] = {}
         self._jl = None
         self._jf = None
+        self._dense = bool(np.asarray(valid).all())
 
     # -- pack-time derived channels (cached) ---------------------------------
 
@@ -138,6 +143,10 @@ class AlignedTiles:
 
     def ff(self, name: str) -> jnp.ndarray:
         """Forward fill: channel value at last valid slot <= i (NaN none)."""
+        if self._dense:
+            # fully-valid tiles: the fill is the channel itself (aliased,
+            # no extra HBM — the common dense-scrape case)
+            return self.ts if name == "ts" else self.channel(name)
         c = self._ff.get(name)
         if c is None:
             if self._jl is None:
@@ -153,6 +162,8 @@ class AlignedTiles:
 
     def bf(self, name: str) -> jnp.ndarray:
         """Backward fill: channel value at first valid slot >= i."""
+        if self._dense:
+            return self.ts if name == "ts" else self.channel(name)
         c = self._bf.get(name)
         if c is None:
             if self._jf is None:
@@ -188,6 +199,39 @@ class AlignedTiles:
             self.bf(n)
         for n in names_ps:
             self.prefix(n)
+
+    # -- transposed (slot-major) channels --------------------------------
+    # [N, S] layout: one query step's shared slot column is a CONTIGUOUS
+    # row, so the per-step gathers of the windowed evaluator read
+    # sequential HBM instead of stride-N*8 columns (~4x faster on TPU).
+    # Built lazily and cached like the row-major channels.
+
+    def _t(self, cache_name: str, name: str, builder) -> jnp.ndarray:
+        cache = getattr(self, cache_name)
+        c = cache.get(name)
+        if c is None:
+            c = jnp.asarray(builder(name).T)
+            cache[name] = c
+        return c
+
+    def t_ts(self) -> jnp.ndarray:
+        return self._t("_tch", "ts_nan", lambda _: self.ts)
+
+    def t_channel(self, name: str) -> jnp.ndarray:
+        return self._t("_tch", name, self.channel)
+
+    def t_ff(self, name: str) -> jnp.ndarray:
+        if self._dense:     # alias: no second transposed copy
+            return self.t_ts() if name == "ts" else self.t_channel(name)
+        return self._t("_tff", name, self.ff)
+
+    def t_bf(self, name: str) -> jnp.ndarray:
+        if self._dense:
+            return self.t_ts() if name == "ts" else self.t_channel(name)
+        return self._t("_tbf", name, self.bf)
+
+    def t_prefix(self, name: str) -> jnp.ndarray:
+        return self._t("_tps", name, self.prefix)
 
 
 def _estimate_dt_candidates(series: Sequence[RawSeries]) -> List[int]:
@@ -466,6 +510,146 @@ def _eval_core(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
         else:
             raise ValueError(f"aligned path cannot evaluate {func}")
     return jnp.where(has, out, nan)
+
+
+# ---------------------------------------------------------------------------
+# Transposed (slot-major) evaluator for the counter family — the north-star
+# hot path. Identical numerics to _eval_core; arrays are [N, S] so each
+# step's slot reads are contiguous rows (≈4x the gather bandwidth of
+# column reads on TPU). Output is [T, S].
+# ---------------------------------------------------------------------------
+
+def _tiles_arrays_t(tiles: AlignedTiles, func: str) -> Dict[str, jnp.ndarray]:
+    vch = "cv" if func in ("rate", "increase") else "v"
+    if tiles._dense:
+        # fully-valid tiles: fills alias the channels and sample counts
+        # are slot arithmetic — only (ts, value) tiles live in HBM
+        return {"ts": tiles.t_ts(), "ff_v": tiles.t_channel(vch)}
+    return {
+        "ts": tiles.t_ts(),
+        "ps_ones": tiles.t_prefix("ones"),
+        "ch_ones": tiles.t_channel("ones"),
+        "ff_ts": tiles.t_ff("ts"),
+        "bf_ts": tiles.t_bf("ts"),
+        "ff_v": tiles.t_ff(vch),
+        "bf_v": tiles.t_bf(vch),
+    }
+
+
+def _extrapolated_rate_t(wstart_d, wend_d, counts, t1, v1, t2, v2,
+                         is_counter, is_rate):
+    """extrapolatedRate on [T, S] tiles (wstart_d/wend_d are [T, 1] f64) —
+    same math as tpu._extrapolated_rate, transposed orientation."""
+    counts = counts.astype(jnp.float64)
+    dstart = (t1 - wstart_d) / 1000.0
+    dend = (wend_d - t2) / 1000.0
+    sampled = (t2 - t1) / 1000.0
+    avg_dur = sampled / (counts - 1.0)
+    delta = v2 - v1
+    if is_counter:
+        dzero = jnp.where((delta > 0) & (v1 >= 0),
+                          sampled * (v1 / jnp.where(delta == 0, jnp.nan,
+                                                    delta)),
+                          jnp.inf)
+        dstart = jnp.minimum(dstart, dzero)
+    thresh = avg_dur * 1.1
+    extrap = sampled \
+        + jnp.where(dstart < thresh, dstart, avg_dur / 2.0) \
+        + jnp.where(dend < thresh, dend, avg_dur / 2.0)
+    scaled = delta * (extrap / sampled)
+    if is_rate:
+        scaled = scaled / (wend_d - wstart_d) * 1000.0
+    return jnp.where(counts >= 2, scaled, jnp.nan)
+
+
+def _eval_counter_t(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
+                    num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
+    """rate/increase/delta over transposed tiles → [T, S] f64.
+
+    With dense tiles (no "ps_ones"/"ff_ts" in ``arrs``) the fills alias
+    the base channels and counts come from slot arithmetic — the hot
+    query reads only (ts, value) rows."""
+    N = num_slots
+    dense = "ps_ones" not in arrs
+    t = jnp.arange(nsteps, dtype=jnp.int64)
+    wend = w0e + t * step
+    wstart = w0s + t * step
+    k_hi = jnp.floor((wend - base + dt / 2.0) / dt).astype(jnp.int64)
+    k_lo = jnp.ceil((wstart - base - dt / 2.0) / dt).astype(jnp.int64)
+    TK = lambda a, k: jnp.take(a, k, axis=0)            # [T, S] rows
+    wend_d = wend.astype(jnp.float64)[:, None]
+    wstart_d = wstart.astype(jnp.float64)[:, None]
+    # counts: prefix diff + edge-slot jitter corrections
+    hi_i = (jnp.clip(k_hi, -1, N - 1) + 1).astype(jnp.int32)
+    lo_i = jnp.clip(k_lo, 0, N).astype(jnp.int32)
+    if dense:
+        counts = (hi_i - lo_i).astype(jnp.float64)[:, None]
+        one = 1.0
+    else:
+        counts = TK(arrs["ps_ones"], hi_i) - TK(arrs["ps_ones"], lo_i)
+    khx = jnp.clip(k_hi, 0, N - 1).astype(jnp.int32)
+    k_hi_ok = ((k_hi >= 0) & (k_hi <= N - 1))[:, None]
+    over = k_hi_ok & (TK(arrs["ts"], khx) > wend_d)
+    counts = counts - jnp.where(
+        over, one if dense else TK(arrs["ch_ones"], khx), 0.0)
+    klx = jnp.clip(k_lo, 0, N - 1).astype(jnp.int32)
+    k_lo_ok = ((k_lo >= 0) & (k_lo <= N - 1))[:, None]
+    under = k_lo_ok & (TK(arrs["ts"], klx) < wstart_d)
+    counts = counts - jnp.where(
+        under, one if dense else TK(arrs["ch_ones"], klx), 0.0)
+    has = counts >= 0.5
+    ff_ts = arrs["ts"] if dense else arrs["ff_ts"]
+    bf_ts = arrs["ts"] if dense else arrs["bf_ts"]
+    bf_v = arrs["ff_v"] if dense else arrs["bf_v"]
+    # last sample <= wend (2-candidate select, as _select_last)
+    kc = jnp.clip(k_hi, 0, N - 1).astype(jnp.int32)
+    kp = jnp.clip(k_hi - 1, 0, N - 1).astype(jnp.int32)
+    none_hi = (k_hi < 0)[:, None]
+    ts1 = TK(ff_ts, kc)
+    use1 = ts1 <= wend_d
+    t2 = jnp.where(none_hi, jnp.nan,
+                   jnp.where(use1, ts1, TK(ff_ts, kp)))
+    v2 = jnp.where(none_hi, jnp.nan,
+                   jnp.where(use1, TK(arrs["ff_v"], kc),
+                             TK(arrs["ff_v"], kp)))
+    # first sample >= wstart
+    kcl = jnp.clip(k_lo, 0, N - 1).astype(jnp.int32)
+    kn = jnp.clip(k_lo + 1, 0, N - 1).astype(jnp.int32)
+    none_lo = (k_lo > N - 1)[:, None]
+    tsb = TK(bf_ts, kcl)
+    useb = tsb >= wstart_d
+    t1 = jnp.where(none_lo, jnp.nan,
+                   jnp.where(useb, tsb, TK(bf_ts, kn)))
+    v1 = jnp.where(none_lo, jnp.nan,
+                   jnp.where(useb, TK(bf_v, kcl),
+                             TK(bf_v, kn)))
+    is_counter = func != "delta"
+    out = _extrapolated_rate_t(wstart_d, wend_d, counts,
+                               t1, v1, t2, v2, is_counter, func == "rate")
+    return jnp.where(has, out, jnp.nan)
+
+
+_EVAL_T_JIT: Dict[Tuple, object] = {}
+
+
+def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
+                        window_ms: int, offset_ms: int = 0) -> jnp.ndarray:
+    """rate/increase/delta on the transposed fast path → [T, S] f64."""
+    assert func in ("rate", "increase", "delta")
+    nsteps = steps.size
+    w0e = np.int64(steps[0] - offset_ms)
+    w0s = np.int64(w0e - window_ms)
+    step = np.int64(steps[1] - steps[0]) if nsteps > 1 else np.int64(1)
+    arrs = _tiles_arrays_t(tiles, func)
+    key = ("t", func, nsteps)
+    fn = _EVAL_T_JIT.get(key)
+    if fn is None:
+        fn = jax.jit(_functools.partial(_eval_counter_t, func, nsteps))
+        _EVAL_T_JIT[key] = fn
+    return fn(arrs, jnp.asarray(np.int64(tiles.num_slots)),
+              jnp.asarray(np.int64(tiles.base_ms)),
+              jnp.asarray(np.int64(tiles.dt_ms)),
+              jnp.asarray(w0s), jnp.asarray(w0e), jnp.asarray(step))
 
 
 import functools as _functools

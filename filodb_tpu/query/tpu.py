@@ -538,8 +538,15 @@ class TpuBackend:
                  else int(np.searchsorted(wends, tail_min, side="left")))
         if t_dev == 0:
             return None     # every window touches live data
-        out = tst.evaluate_aligned(tiles, func, steps[:t_dev], window_ms,
-                                   offset_ms, func_args)
+        if func in ("rate", "increase", "delta"):
+            # counter family rides the slot-major fast path (contiguous
+            # boundary reads; identical f64 numerics — test_tilestore
+            # pins bit-parity with evaluate_aligned)
+            out = tst.evaluate_counters_t(tiles, func, steps[:t_dev],
+                                          window_ms, offset_ms).T
+        else:
+            out = tst.evaluate_aligned(tiles, func, steps[:t_dev],
+                                       window_ms, offset_ms, func_args)
         res = np.asarray(out)
         if len(idx) != res.shape[0]:
             return None

@@ -37,6 +37,22 @@ def test_plan_to_promql_roundtrip(q):
     assert again == plan, f"{q!r} -> {printed!r}"
 
 
+def test_printer_precision_and_metric_validation():
+    """Regression: numbers round-trip at full precision (no %g
+    truncation) and non-identifier metric names stay as matchers."""
+    tsp = TimeStepParams(T0, 60, T0 + 600)
+    for q in ["rate(reqs_total[5m] @ 1600000123)",
+              "(cpu) > bool (1600000123)",
+              "quantile_over_time(0.123456789, cpu[10m])"]:
+        plan = parse_query_range(q, tsp)
+        printed = plan_to_promql(plan)
+        assert parse_query_range(printed, tsp) == plan, printed
+    plan = parse_query_range('rate({__name__="my-metric"}[5m])', tsp)
+    printed = plan_to_promql(plan)
+    assert printed is not None
+    assert parse_query_range(printed, tsp) == plan, printed
+
+
 def test_unprintable_shapes_return_none():
     tsp = TimeStepParams(T0, 60, T0 + 600)
     # subqueries have no printer yet -> fall back to leaf dispatch

@@ -191,3 +191,79 @@ def test_variance_large_offset_no_cancellation(func):
                                equal_nan=True)
     # sanity: results are finite wherever the oracle is
     assert np.isnan(got).sum() == np.isnan(want).sum()
+
+
+def test_transposed_counter_eval_matches_row_major():
+    """The slot-major fast path (evaluate_counters_t) must match the
+    row-major evaluator bit-for-bit on gappy jittered tiles."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.query import tilestore as tst
+    rng = np.random.default_rng(11)
+    S, N, dt = 24, 96, 10_000
+    base = 1_600_000_000_000
+    valid = rng.random((S, N)) > 0.15
+    valid[3] = False
+    valid[4, : N // 2] = False
+    ts_true = (base + np.arange(N)[None, :] * dt
+               + rng.integers(-2000, 2000, (S, N))).astype(np.float64)
+    vals = np.cumsum(rng.uniform(0, 5, (S, N)), axis=1)
+    vals[7, 40:] *= 0.2          # a counter reset
+    tiles = tst.AlignedTiles([{} for _ in range(S)], base, dt, valid,
+                             ts_true, vals)
+    steps = base + 400_000 + np.arange(37) * 60_000
+    for func in ("rate", "increase", "delta"):
+        want = np.asarray(tst.evaluate_aligned(tiles, func, steps,
+                                               300_000))
+        got = np.asarray(tst.evaluate_counters_t(tiles, func, steps,
+                                                 300_000)).T
+        np.testing.assert_array_equal(got, want, err_msg=func)
+
+
+def test_dense_alias_keeps_semantics():
+    """Fully-valid tiles alias ff/bf to the raw channels; results must not
+    change vs a near-dense tile evaluated the general way."""
+    from filodb_tpu.query import tilestore as tst
+    rng = np.random.default_rng(5)
+    S, N, dt = 8, 64, 10_000
+    base = 1_600_000_000_000
+    ts_true = (base + np.arange(N)[None, :] * dt
+               + rng.integers(-2000, 2000, (S, N))).astype(np.float64)
+    vals = np.cumsum(rng.uniform(0, 5, (S, N)), axis=1)
+    dense = tst.AlignedTiles([{} for _ in range(S)], base, dt,
+                             np.ones((S, N), bool), ts_true, vals)
+    assert dense._dense
+    # force the general (non-alias) fills by faking density off
+    general = tst.AlignedTiles([{} for _ in range(S)], base, dt,
+                               np.ones((S, N), bool), ts_true, vals)
+    general._dense = False
+    steps = base + 400_000 + np.arange(19) * 60_000
+    for func in ("rate", "sum_over_time", "last_over_time"):
+        a = np.asarray(tst.evaluate_aligned(dense, func, steps, 300_000))
+        b = np.asarray(tst.evaluate_aligned(general, func, steps, 300_000))
+        np.testing.assert_array_equal(a, b, err_msg=func)
+
+
+def test_transposed_dense_fast_path_matches():
+    """Dense tiles drop the ps/ch arrays (arithmetic counts) — results
+    must still match the general row-major evaluator exactly."""
+    from filodb_tpu.query import tilestore as tst
+    rng = np.random.default_rng(17)
+    S, N, dt = 16, 128, 10_000
+    base = 1_600_000_000_000
+    ts_true = (base + np.arange(N)[None, :] * dt
+               + rng.integers(-2000, 2000, (S, N))).astype(np.float64)
+    vals = np.cumsum(rng.uniform(0, 5, (S, N)), axis=1)
+    vals[5, 60:] *= 0.1          # reset
+    tiles = tst.AlignedTiles([{} for _ in range(S)], base, dt,
+                             np.ones((S, N), bool), ts_true, vals)
+    assert tiles._dense
+    assert "ps_ones" not in tst._tiles_arrays_t(tiles, "rate")
+    # query grid pokes beyond both edges to exercise the clamps
+    steps = base - 120_000 + np.arange(40) * 60_000
+    for func in ("rate", "increase", "delta"):
+        want = np.asarray(tst.evaluate_aligned(tiles, func, steps,
+                                               300_000))
+        got = np.asarray(tst.evaluate_counters_t(tiles, func, steps,
+                                                 300_000)).T
+        np.testing.assert_array_equal(got, want, err_msg=func)
