@@ -80,7 +80,7 @@ class TimeSeriesPartition:
                  "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
                  "ingested", "ooo_dropped", "_decode_cache", "_merge_cache",
                  "persisted_chunks", "odp_pending", "_cache_lock",
-                 "card_active")
+                 "card_active", "on_encode")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: DataSchema,
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS):
@@ -103,6 +103,7 @@ class TimeSeriesPartition:
         self.persisted_chunks = 0   # prefix of `chunks` already in the store
         self.odp_pending = False    # True: chunks live in the ColumnStore
         self.card_active = True     # counted as active in the tracker
+        self.on_encode = None       # chunk-encoded hook (flush downsample)
         # guards _decode_cache/_merge_cache population: concurrent HTTP
         # query threads share these caches (the chunk list itself is only
         # appended to, and readers work off a snapshot length)
@@ -225,6 +226,10 @@ class TimeSeriesPartition:
             self.chunks.append(info)
             self._ts_buf = []
             self._col_bufs = [[] for _ in self.schema.data_columns]
+        if self.on_encode is not None:
+            # flush-time downsample emission rides every encode, including
+            # buffer-full encodes during ingest (ShardDownsampler.scala:40)
+            self.on_encode(self.part_key, self.schema, info)
         return info
 
     # -- read path --------------------------------------------------------
@@ -416,7 +421,8 @@ class TimeSeriesShard:
                  max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
                  max_series: int = 1_000_000,
                  column_store: Optional[object] = None,
-                 card_tracker: Optional[object] = None):
+                 card_tracker: Optional[object] = None,
+                 flush_downsampler: Optional[object] = None):
         self.ref = ref
         self.schemas = schemas
         self.shard_num = shard_num
@@ -425,6 +431,8 @@ class TimeSeriesShard:
         self.max_series = max_series  # cardinality quota (ratelimit/)
         # per-(ws,ns,metric) quota tree (ratelimit/CardinalityTracker)
         self.card_tracker = card_tracker
+        # flush-time downsample emission (ShardDownsampler.scala:40)
+        self.flush_downsampler = flush_downsampler
         self.column_store = column_store  # ChunkSink/RawChunkSource boundary
         self.partitions: Dict[int, TimeSeriesPartition] = {}
         self._by_part_key: Dict[bytes, int] = {}
@@ -469,6 +477,8 @@ class TimeSeriesShard:
         self._next_part_id += 1
         part = TimeSeriesPartition(pid, part_key, schema, self.max_chunk_rows)
         part.card_active = active
+        if self.flush_downsampler is not None:
+            part.on_encode = self.flush_downsampler.on_chunk
         self.partitions[pid] = part
         self._by_part_key[kb] = pid
         self.index.add_part_key(pid, part_key.label_map, first_ts)
@@ -566,6 +576,10 @@ class TimeSeriesShard:
             self.column_store.write_part_keys(self.ref.dataset,
                                               self.shard_num, entries)
         self.stats.flushes_done += 1
+        if self.flush_downsampler is not None:
+            # persist pending ds records (also covers chunks encoded by
+            # buffer-full switches during ingest since the last flush)
+            self.flush_downsampler.flush()
         if offset >= 0:
             self.checkpoints[group] = offset
             if self.column_store is not None:
@@ -660,6 +674,46 @@ class TimeSeriesShard:
         return out
 
     # -- eviction ---------------------------------------------------------
+    def resident_samples(self) -> int:
+        """Samples held in memory (encoded chunks + write buffers); ODP
+        shells count 0 (their data lives in the ColumnStore)."""
+        n = 0
+        for p in self.partitions.values():
+            n += sum(c.num_rows for c in p.chunks) + len(p._ts_buf)
+        return n
+
+    def ensure_headroom(self, max_samples: int,
+                        headroom_pct: int = 25) -> int:
+        """Memory-pressure eviction: when resident samples exceed the
+        budget, evict the least-recently-written partitions until
+        ``headroom_pct`` percent of the budget is free again
+        (the reference's headroom task + PartitionEvictionPolicy
+        watermark, TimeSeriesShard ensureFreeSpace /
+        ensure-block-memory-headroom-percent). Requires a ColumnStore
+        (eviction turns partitions into ODP shells) or drops series.
+        Returns partitions evicted."""
+        if max_samples <= 0:
+            return 0
+        cur = self.resident_samples()
+        if cur <= max_samples:
+            return 0
+        target = max_samples * (100 - headroom_pct) // 100
+        parts = sorted(
+            ((p.last_timestamp, p) for p in self.partitions.values()
+             if p.last_timestamp is not None and p.chunks
+             and not p._ts_buf and not p.odp_pending),
+            key=lambda x: x[0])
+        freed = 0
+        cutoff = None
+        for last_ts, p in parts:
+            if cur - freed <= target:
+                break
+            freed += sum(c.num_rows for c in p.chunks)
+            cutoff = last_ts + 1
+        if cutoff is None:
+            return 0
+        return self.evict_partitions(cutoff_ts=cutoff)
+
     def evict_partitions(self, cutoff_ts: int) -> int:
         """Evict series whose data ended before cutoff
         (PartitionEvictionPolicy / EvictablePartIdQueueSet equivalents).
@@ -737,7 +791,9 @@ class TimeSeriesMemStore:
     def setup(self, ref: DatasetRef, shard_num: int, num_groups: int = 8,
               max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
               bootstrap: bool = False,
-              card_tracker: Optional[object] = None) -> TimeSeriesShard:
+              card_tracker: Optional[object] = None,
+              flush_downsampler: Optional[object] = None
+              ) -> TimeSeriesShard:
         """Create one shard; with ``bootstrap`` (and a column store) the tag
         index + checkpoints are recovered from persistence
         (TimeSeriesMemStore.scala setup + IndexBootstrapper on startup)."""
@@ -747,7 +803,8 @@ class TimeSeriesMemStore:
         shard = TimeSeriesShard(ref, self.schemas, shard_num, num_groups,
                                 max_chunk_rows,
                                 column_store=self.column_store,
-                                card_tracker=card_tracker)
+                                card_tracker=card_tracker,
+                                flush_downsampler=flush_downsampler)
         shards[shard_num] = shard
         if bootstrap:
             shard.bootstrap_from_store()

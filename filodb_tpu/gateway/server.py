@@ -33,11 +33,15 @@ class GatewayServer:
     def __init__(self, streams: Dict[int, IngestionStream], schemas: Schemas,
                  num_shards: int, spread: int = 1, port: int = 0,
                  host: str = "127.0.0.1", batch_lines: int = 256,
-                 ws: str = "demo", ns: str = "App-0"):
+                 ws: str = "demo", ns: str = "App-0",
+                 spread_provider=None):
         self.streams = streams
         self.schemas = schemas
         self.num_shards = num_shards
         self.spread = spread
+        # per-shard-key overrides; the planner prunes with the SAME
+        # provider so ingest and query always agree (SpreadProvider)
+        self.spread_provider = spread_provider
         self.batch_lines = batch_lines
         self.ws, self.ns = ws, ns
         self.part_schema = PartitionSchema()
@@ -81,8 +85,13 @@ class GatewayServer:
         for schema_name, labels, ts, values in samples:
             schema = self.schemas.by_name(schema_name)
             pk = PartKey.make(schema, labels)
+            if self.spread_provider is not None:
+                spread = self.spread_provider.spread_for_labels(
+                    labels, self.part_schema.non_metric_shard_key_columns)
+            else:
+                spread = self.spread
             shard = ingestion_shard(pk.shard_key_hash(self.part_schema),
-                                    pk.part_hash(), self.spread,
+                                    pk.part_hash(), spread,
                                     self.num_shards)
             b = builders.setdefault(shard, RecordBuilder(self.schemas))
             b.add_sample(schema_name, labels, ts, *values)

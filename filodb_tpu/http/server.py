@@ -47,6 +47,7 @@ class FiloHttpServer:
                  ds_store_by_dataset: Optional[Dict[str, object]] = None,
                  raw_retention_ms: int = 0,
                  query_limits: Optional[QueryLimits] = None,
+                 spread_provider: Optional[object] = None,
                  node_id: Optional[str] = None,
                  peers: Optional[Dict[str, str]] = None):
         self.shards_by_dataset = shards_by_dataset
@@ -57,6 +58,7 @@ class FiloHttpServer:
         self.ds_store_by_dataset = ds_store_by_dataset or {}
         self.raw_retention_ms = raw_retention_ms
         self.query_limits = query_limits
+        self.spread_provider = spread_provider
         # multi-process cluster plane (parallel/cluster.py): this node's id
         # + peer node_id -> base URL for leaf dispatch and metadata fan-out
         self.node_id = node_id
@@ -156,6 +158,7 @@ class FiloHttpServer:
                               ds_store=self.ds_store_by_dataset.get(ds),
                               raw_retention_ms=self.raw_retention_ms,
                               limits=self.query_limits,
+                              spread_provider=self.spread_provider,
                               node_id=self.node_id, peers=peers,
                               dataset=ds)
         if rest == "query_range":

@@ -43,7 +43,8 @@ class IngestionDriver:
                  flush_every_records: Optional[int] = None,
                  flush_interval_s: float = 1.0,
                  poll_interval_s: float = 0.02,
-                 on_event: Optional[Callable] = None):
+                 on_event: Optional[Callable] = None,
+                 max_resident_samples: int = 0):
         self.shard = shard
         self.stream = stream
         self.mapper = mapper
@@ -51,6 +52,8 @@ class IngestionDriver:
         self.flush_interval_s = flush_interval_s
         self.poll_interval_s = poll_interval_s
         self.on_event = on_event or (lambda *a: None)
+        # memory-pressure watermark (0 = no cap): checked after flushes
+        self.max_resident_samples = max_resident_samples
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._next_group = 0
@@ -143,6 +146,8 @@ class IngestionDriver:
         group = self._next_group
         self._next_group = (self._next_group + 1) % self.shard.num_groups
         self.shard.flush_group(group, offset=self.next_offset - 1)
+        if self.max_resident_samples:
+            self.shard.ensure_headroom(self.max_resident_samples)
         self._records_since_flush = 0
         self._last_flush_t = time.monotonic()
 

@@ -430,6 +430,7 @@ class QueryPlanner:
                  raw_retention_ms: int = 0,
                  now_ms=None,
                  limits: Optional[QueryLimits] = None,
+                 spread_provider: Optional[object] = None,
                  node_id: Optional[str] = None,
                  peers: Optional[Dict[str, str]] = None,
                  dataset: str = "timeseries"):
@@ -440,6 +441,9 @@ class QueryPlanner:
         self.mapper = shard_mapper
         self.mesh = mesh_executor
         self.spread = spread
+        # per-shard-key spread overrides (core/SpreadProvider.scala); must
+        # be the same provider the ingest edge routes with
+        self.spread_provider = spread_provider
         self.shard_key_columns = tuple(shard_key_columns)
         self.metric_column = metric_column
         # raw/downsample tiering (LongTimeRangePlanner.scala:30): queries
@@ -479,7 +483,9 @@ class QueryPlanner:
                 return None
             values.append(eqs[c])
         skh = shard_key_hash(values, metric)
-        return self.mapper.query_shards(skh, self.spread)
+        spread = self.spread_provider.spread_for(values) \
+            if self.spread_provider is not None else self.spread
+        return self.mapper.query_shards(skh, spread)
 
     def _resolve_shards(self, plan) -> List[object]:
         """Union of pruned shard subsets across all leaves; all shards when

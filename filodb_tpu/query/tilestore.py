@@ -461,7 +461,8 @@ def _eval_core(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
         vch = "cv" if is_counter else "v"
         t2, v2 = _select_last(arrs, ["ts", vch], N, k_hi, wend)
         t1, v1 = _select_first(arrs, ["ts", vch], N, k_lo, wstart)
-        out = _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2,
+        out = _extrapolated_rate(wstart[None, :], wend[None, :], counts,
+                                 t1, v1, t2, v2,
                                  is_counter, func == "rate")
         return jnp.where(has, out, nan)
 
@@ -536,32 +537,6 @@ def _tiles_arrays_t(tiles: AlignedTiles, func: str) -> Dict[str, jnp.ndarray]:
     }
 
 
-def _extrapolated_rate_t(wstart_d, wend_d, counts, t1, v1, t2, v2,
-                         is_counter, is_rate):
-    """extrapolatedRate on [T, S] tiles (wstart_d/wend_d are [T, 1] f64) —
-    same math as tpu._extrapolated_rate, transposed orientation."""
-    counts = counts.astype(jnp.float64)
-    dstart = (t1 - wstart_d) / 1000.0
-    dend = (wend_d - t2) / 1000.0
-    sampled = (t2 - t1) / 1000.0
-    avg_dur = sampled / (counts - 1.0)
-    delta = v2 - v1
-    if is_counter:
-        dzero = jnp.where((delta > 0) & (v1 >= 0),
-                          sampled * (v1 / jnp.where(delta == 0, jnp.nan,
-                                                    delta)),
-                          jnp.inf)
-        dstart = jnp.minimum(dstart, dzero)
-    thresh = avg_dur * 1.1
-    extrap = sampled \
-        + jnp.where(dstart < thresh, dstart, avg_dur / 2.0) \
-        + jnp.where(dend < thresh, dend, avg_dur / 2.0)
-    scaled = delta * (extrap / sampled)
-    if is_rate:
-        scaled = scaled / (wend_d - wstart_d) * 1000.0
-    return jnp.where(counts >= 2, scaled, jnp.nan)
-
-
 def _eval_counter_t(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
                     num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
     """rate/increase/delta over transposed tiles → [T, S] f64.
@@ -623,9 +598,10 @@ def _eval_counter_t(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
     v1 = jnp.where(none_lo, jnp.nan,
                    jnp.where(useb, TK(bf_v, kcl),
                              TK(bf_v, kn)))
+    from filodb_tpu.query.tpu import _extrapolated_rate
     is_counter = func != "delta"
-    out = _extrapolated_rate_t(wstart_d, wend_d, counts,
-                               t1, v1, t2, v2, is_counter, func == "rate")
+    out = _extrapolated_rate(wstart_d, wend_d, counts,
+                             t1, v1, t2, v2, is_counter, func == "rate")
     return jnp.where(has, out, jnp.nan)
 
 

@@ -167,10 +167,12 @@ def _correction(vals, lens):
 
 def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
                        is_rate):
-    """(rangefn/RateFunctions.scala:37 extrapolatedRate, on device.)"""
+    """(rangefn/RateFunctions.scala:37 extrapolatedRate, on device.)
+    Shape-agnostic: callers broadcast wstart/wend against their tile
+    orientation ([S, T] row-major or [T, S] slot-major)."""
     counts = counts.astype(jnp.float64)
-    dstart = (t1 - wstart[None, :]).astype(jnp.float64) / 1000.0
-    dend = (wend[None, :] - t2).astype(jnp.float64) / 1000.0
+    dstart = (t1 - wstart).astype(jnp.float64) / 1000.0
+    dend = (wend - t2).astype(jnp.float64) / 1000.0
     sampled = (t2 - t1).astype(jnp.float64) / 1000.0
     avg_dur = sampled / (counts - 1.0)
     delta = v2 - v1
@@ -186,7 +188,7 @@ def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
         + jnp.where(dend < thresh, dend, avg_dur / 2.0)
     scaled = delta * (extrap / sampled)
     if is_rate:
-        scaled = scaled / (wend - wstart)[None, :] * 1000.0
+        scaled = scaled / (wend - wstart) * 1000.0
     return jnp.where(counts >= 2, scaled, jnp.nan)
 
 
@@ -209,7 +211,7 @@ def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
     if func in _ENDPOINT_RATE:
         counter, is_rate = _ENDPOINT_RATE[func]
         v = vals + _correction(vals, lens) if counter else vals
-        out = _extrapolated_rate(wstart, wend, counts,
+        out = _extrapolated_rate(wstart[None, :], wend[None, :], counts,
                                  _take(ts, lo_c), _take(v, lo_c),
                                  _take(ts, hi_c), _take(v, hi_c),
                                  counter, is_rate)
@@ -357,7 +359,7 @@ def _pallas_rate_impl(func, nsteps, interpret, ts, vals, lens, w0s, w0e,
     t2 = thi.astype(jnp.int64) + w0s
     v1 = pk.combine3(plo)
     v2 = pk.combine3(phi)
-    out = _extrapolated_rate(wstart, wend, cnt, t1, v1, t2, v2,
+    out = _extrapolated_rate(wstart[None, :], wend[None, :], cnt, t1, v1, t2, v2,
                              is_counter, func == "rate")
     return jnp.where(cnt >= 1, out, jnp.nan)
 

@@ -57,6 +57,12 @@ DEFAULTS = {
     "raw-retention-s": None,
     # downsample resolutions in ms (conf multi-resolution config)
     "downsample-resolutions": [300_000, 3_600_000],
+    # emit downsample records during flush (ShardDownsampler.scala:40);
+    # requires data-dir. The batch job remains for backfill + histograms.
+    "flush-downsample": False,
+    # per-shard resident-sample budget; exceeded -> evict least-recently
+    # written partitions to ODP shells (headroom task). 0 = no cap.
+    "max-resident-samples": 0,
     # per-query guardrails (filodb-defaults.conf sample-limit equivalent;
     # 0 = unlimited). Over-limit queries return HTTP 422.
     "query-sample-limit": 1_000_000,
@@ -68,6 +74,9 @@ DEFAULTS = {
     "num-nodes": 1,
     "node-ordinal": 0,
     "peers": {},
+    # per-shard-key spread overrides {"ws,ns": spread}
+    # (core/SpreadProvider.scala; doc/sharding.md "Spread")
+    "spread-overrides": {},
     # cardinality quotas (ratelimit QuotaSource, filodb-defaults.conf:277):
     # default quota per prefix depth [root, ws, ns, metric]; 0 = unlimited.
     # Per-prefix overrides: {"ws,ns": quota}. Breaches drop new series.
@@ -110,6 +119,10 @@ class FiloServer:
             self.node_id = self.config["node-id"]
             self.owned_shards = list(range(n))
         from filodb_tpu.core.cardinality import CardinalityTracker
+        from filodb_tpu.core.spread import SpreadProvider
+        self.spread_provider = SpreadProvider(
+            int(self.config.get("default-spread", 1)),
+            dict(self.config.get("spread-overrides") or {}))
         self.card_trackers = {}
         for shard in self.owned_shards:
             tracker = CardinalityTracker(
@@ -119,11 +132,22 @@ class FiloServer:
                 tracker.set_quota([p for p in pfx.split(",") if p],
                                   int(quota))
             self.card_trackers[shard] = tracker
-            self.store.setup(self.ref, shard,
-                             num_groups=self.config["groups-per-shard"],
-                             max_chunk_rows=self.config["max-chunks-size"],
-                             bootstrap=self.store.column_store is not None,
-                             card_tracker=tracker)
+            fds = None
+            if self.config.get("flush-downsample") \
+                    and self.store.column_store is not None:
+                from filodb_tpu.downsample.flush import FlushDownsampler
+                fds = FlushDownsampler(
+                    self.store.column_store, self.config["dataset"], shard,
+                    DEFAULT_SCHEMAS,
+                    resolutions=tuple(
+                        self.config["downsample-resolutions"]))
+            self.store.setup(
+                self.ref, shard,
+                num_groups=self.config["groups-per-shard"],
+                max_chunk_rows=self.config["max-chunks-size"],
+                bootstrap=self.store.column_store is not None,
+                card_tracker=tracker,
+                flush_downsampler=fds)
         if num_nodes > 1:
             for i in range(num_nodes):
                 for shard in shards_for_ordinal(i, num_nodes, n):
@@ -175,6 +199,7 @@ class FiloServer:
             query_limits=QueryLimits(
                 series_limit=int(self.config.get("query-series-limit", 0)),
                 sample_limit=int(self.config.get("query-sample-limit", 0))),
+            spread_provider=self.spread_provider,
             node_id=self.node_id, peers=peers)
         self.http.start()
         if peers:
@@ -209,13 +234,16 @@ class FiloServer:
                 mapper=self.mapper,
                 flush_every_records=self.config.get("flush-every-records"),
                 flush_interval_s=float(self.config.get("flush-interval-s",
-                                                       2.0)))
+                                                       2.0)),
+                max_resident_samples=int(
+                    self.config.get("max-resident-samples", 0)))
             self.drivers.append(drv.start())
         if self.config.get("gateway-port") is not None:
             from filodb_tpu.gateway.server import GatewayServer
             self.gateway = GatewayServer(
                 self.streams, DEFAULT_SCHEMAS, num_shards=n,
                 spread=int(self.config.get("default-spread", 1)),
+                spread_provider=self.spread_provider,
                 port=int(self.config["gateway-port"])).start()
 
     def seed_dev_data(self, n_samples: int = 360, n_instances: int = 4,

@@ -1,0 +1,97 @@
+"""CLI surface (cli/CliMain.scala:159-266 equivalents) against a live
+in-process server + offline debug commands."""
+
+import contextlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+from filodb_tpu import cli
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = FiloServer({"num-shards": 2, "port": 0}).start()
+    srv.seed_dev_data(n_samples=30, n_instances=2, start_ms=T0 * 1000)
+    yield srv
+    srv.stop()
+
+
+def _run(*argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli.main(list(argv))
+    return json.loads(buf.getvalue())
+
+
+def test_status(server):
+    out = _run("--host", f"http://127.0.0.1:{server.port}", "status")
+    assert {s["shard"] for s in out["data"]} == {0, 1}
+
+
+def test_labels_and_values(server):
+    host = f"http://127.0.0.1:{server.port}"
+    labels = _run("--host", host, "labels")
+    assert "_ws_" in labels["data"]
+    vals = _run("--host", host, "labelvalues", "_ws_")
+    assert vals["data"] == ["demo"]
+
+
+def test_query_range(server):
+    host = f"http://127.0.0.1:{server.port}"
+    out = _run("--host", host, "query-range",
+               "rate(http_requests_total[5m])",
+               "--start", str(T0 + 100), "--end", str(T0 + 290),
+               "--step", "60")
+    assert out["status"] == "success"
+
+
+def test_tscard_and_topk(server):
+    host = f"http://127.0.0.1:{server.port}"
+    out = _run("--host", host, "tscard", "--prefix", "demo")
+    assert out["data"][0]["tsCount"] > 0
+    top = _run("--host", host, "topkcard", "--prefix", "demo", "-k", "1")
+    assert len(top) == 1
+
+
+def test_find_query_shards():
+    out = _run("find-query-shards", "demo,App-0", "heap_usage",
+               "--spread", "1", "--num-shards", "4")
+    assert len(out["shards"]) == 2
+
+
+def test_validate_schemas():
+    out = _run("validate-schemas")
+    assert out["ok"] and "prom-counter" in out["schemas"]
+
+
+def test_decode_vector_roundtrip():
+    from filodb_tpu.memory import vectors as bv
+    vals = np.arange(10, dtype=np.float64) * 1.5
+    buf = bv.encode_doubles(vals)
+    out = _run("decode-vector", "hex:" + buf.hex())
+    np.testing.assert_allclose(out["values"], vals)
+
+
+def test_decode_chunk_info(tmp_path):
+    from filodb_tpu.core.memstore import TimeSeriesShard
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+    from filodb_tpu.store import FlatFileColumnStore
+    cs = FlatFileColumnStore(str(tmp_path))
+    shard = TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, 0,
+                            column_store=cs)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(20):
+        b.add_sample("gauge", {"_metric_": "m", "_ws_": "w", "_ns_": "n"},
+                     1000 + t * 10, float(t))
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all(offset=1)
+    out = _run("decode-chunk-info", str(tmp_path))
+    assert out and out[0]["numRows"] == 20

@@ -1,0 +1,103 @@
+"""Flush-time downsample emission + memory-pressure headroom eviction.
+
+(ShardDownsampler.scala:40,62 populateDownsampleRecords;
+PartitionEvictionPolicy / headroom task equivalents.)
+"""
+
+import numpy as np
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.downsample import DownsampledTimeSeriesStore
+from filodb_tpu.downsample.flush import FlushDownsampler
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.store import FlatFileColumnStore
+
+REF = DatasetRef("timeseries")
+RES = 300_000
+T0 = (1_600_000_000_000 // RES) * RES
+OFF = 5_000
+
+
+def _seed(shard, n=720):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s in range(3):
+        g = {"_metric_": "cpu", "_ws_": "demo", "_ns_": "App-0",
+             "instance": f"i{s}"}
+        c = {"_metric_": "reqs_total", "_ws_": "demo", "_ns_": "App-0",
+             "instance": f"i{s}"}
+        for t in range(n):
+            ts = T0 + OFF + t * 10_000
+            b.add_sample("gauge", g, ts, 50.0 + s + np.sin(t / 9.0) * 20)
+            b.add_sample("prom-counter", c, ts, float((t + 1) * (s + 1)))
+    for cont in b.containers():
+        shard.ingest(cont)
+
+
+def test_flush_emission_serves_ds_queries(tmp_path):
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                            max_chunk_rows=120)
+    shard.flush_downsampler = FlushDownsampler(
+        cs, "timeseries", 0, DEFAULT_SCHEMAS, resolutions=(RES,))
+    _seed(shard)
+    shard.flush_all(offset=1)
+    assert shard.flush_downsampler.samples_emitted > 0
+
+    # ds tier is immediately queryable WITHOUT running the batch job
+    dstore = DownsampledTimeSeriesStore(cs, "timeseries", 1,
+                                        resolutions=(RES,))
+    tsp = TimeStepParams(T0 // 1000 + 1800, 600, T0 // 1000 + 7000)
+    for q, rtol in [("min_over_time(cpu[10m])", 0.0),
+                    ("sum_over_time(cpu[10m])", 0.0),
+                    ("increase(reqs_total[10m])", 0.05)]:
+        plan = parse_query_range(q, tsp)
+        picked = dstore.plan_query(plan, 600_000, 600_000)
+        assert picked is not None, q
+        ds_shards, ds_plan = picked
+        got = QueryEngine(ds_shards).execute(ds_plan)
+        want = QueryEngine([shard]).execute(plan)
+        gmap = {k["instance"]: got.values[i]
+                for i, k in enumerate(got.keys)}
+        assert len(gmap) == want.num_series, q
+        for i, k in enumerate(want.keys):
+            g, w = gmap[k["instance"]], want.values[i]
+            ok = np.isfinite(w) & np.isfinite(g)
+            assert ok.sum() >= w.size - 2, q
+            if rtol:
+                np.testing.assert_allclose(g[ok], w[ok], rtol=rtol,
+                                           err_msg=q)
+            else:
+                np.testing.assert_allclose(g[ok], w[ok], rtol=1e-9,
+                                           err_msg=q)
+
+
+def test_headroom_eviction(tmp_path):
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                            max_chunk_rows=100)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s in range(10):
+        labels = {"_metric_": "m", "_ws_": "w", "_ns_": "n",
+                  "instance": f"i{s}"}
+        for t in range(200):
+            # staggered recency: series s ends at T0 + (s+1)*2000s
+            b.add_sample("gauge", labels, T0 + s * 2_000_000 + t * 10_000,
+                         float(t))
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all(offset=1)
+    before = shard.resident_samples()
+    assert before == 2000
+    evicted = shard.ensure_headroom(max_samples=1000)
+    assert evicted > 0
+    after = shard.resident_samples()
+    assert after <= 1000 * 0.75 + 200      # within headroom (+1 part slop)
+    # evicted data still answers via ODP page-in
+    tsp = TimeStepParams(T0 // 1000, 600, T0 // 1000 + 2_000 * 10)
+    out = QueryEngine([shard]).execute(parse_query_range("m", tsp))
+    assert out.num_series == 10
+    # under budget: no-op
+    assert shard.ensure_headroom(max_samples=10_000_000) == 0

@@ -1,0 +1,65 @@
+"""Per-shard-key spread overrides: one SpreadProvider drives both the
+ingest edge and the query planner (core/SpreadProvider.scala;
+doc/sharding.md "Spread" — hot keys fan across 2^spread shards).
+"""
+
+import numpy as np
+
+from filodb_tpu.core.record import (PartKey, ingestion_shard,
+                                    shard_key_hash)
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, PartitionSchema
+from filodb_tpu.core.spread import SpreadProvider
+
+
+def test_overrides_and_default():
+    sp = SpreadProvider(1, {"demo,hot-ns": 2})
+    assert sp.spread_for(["demo", "App-0"]) == 1
+    assert sp.spread_for(["demo", "hot-ns"]) == 2
+    assert sp.spread_for_labels({"_ws_": "demo", "_ns_": "hot-ns"},
+                                ("_ws_", "_ns_")) == 2
+
+
+def test_ingest_and_query_agree_per_key():
+    """Every series the gateway-routing puts in a shard must be inside
+    the planner's pruned shard set, for BOTH default and override keys."""
+    from filodb_tpu.core.record import query_shards
+    sp = SpreadProvider(0, {"demo,hot-ns": 2})
+    part_schema = PartitionSchema()
+    num_shards = 8
+    for ns, metric in (("App-0", "cpu"), ("hot-ns", "cpu")):
+        spread = sp.spread_for(["demo", ns])
+        qshards = set(query_shards(
+            shard_key_hash(["demo", ns], metric), spread, num_shards))
+        assert len(qshards) == 1 << spread
+        for i in range(64):
+            labels = {"_metric_": metric, "_ws_": "demo", "_ns_": ns,
+                      "instance": f"i{i}"}
+            pk = PartKey.make(DEFAULT_SCHEMAS.by_name("gauge"), labels)
+            sh = ingestion_shard(pk.shard_key_hash(part_schema),
+                                 pk.part_hash(), spread, num_shards)
+            assert sh in qshards
+
+
+def test_planner_uses_provider(tmp_path):
+    from filodb_tpu.core.memstore import TimeSeriesShard
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DatasetRef
+    from filodb_tpu.parallel.shardmapper import (ShardMapper,
+                                                 assign_shards_evenly)
+    from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+    from filodb_tpu.query.planner import QueryPlanner
+    sp = SpreadProvider(0, {"demo,hot-ns": 1})
+    mapper = ShardMapper(4)
+    assign_shards_evenly(mapper, ["n0"])
+    for i in range(4):
+        mapper.activate(i)
+    shards = [TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, i)
+              for i in range(4)]
+    planner = QueryPlanner(shards, shard_mapper=mapper,
+                           spread_provider=sp)
+    tsp = TimeStepParams(1_600_000_000, 60, 1_600_000_600)
+    cold = parse_query_range('cpu{_ws_="demo",_ns_="App-0"}', tsp)
+    hot = parse_query_range('cpu{_ws_="demo",_ns_="hot-ns"}', tsp)
+    n_cold = len(planner.shards_from_filters(cold.raw.filters))
+    n_hot = len(planner.shards_from_filters(hot.raw.filters))
+    assert n_cold == 1 and n_hot == 2
