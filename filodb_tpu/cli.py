@@ -150,54 +150,66 @@ def cmd_decode_chunk_info(a):
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(prog="filodb-tpu-cli", description=__doc__)
-    p.add_argument("--host", default="http://127.0.0.1:8080")
-    p.add_argument("--dataset", default="timeseries")
+    # --host/--dataset are accepted both before AND after the subcommand
+    # (the docstring shows the latter)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--host", default="http://127.0.0.1:8080")
+    common.add_argument("--dataset", default="timeseries")
+    # the subparser copy must NOT re-apply defaults, or an unset
+    # post-command --host would clobber a pre-command one
+    sub_common = argparse.ArgumentParser(add_help=False)
+    sub_common.add_argument("--host", default=argparse.SUPPRESS)
+    sub_common.add_argument("--dataset", default=argparse.SUPPRESS)
+    p = argparse.ArgumentParser(prog="filodb-tpu-cli", description=__doc__,
+                                parents=[common])
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("status").set_defaults(fn=cmd_status)
-    sp = sub.add_parser("labels")
+    def add(name):
+        return sub.add_parser(name, parents=[sub_common])
+
+    add("status").set_defaults(fn=cmd_status)
+    sp = add("labels")
     sp.add_argument("--match", action="append")
     sp.set_defaults(fn=cmd_labels)
-    sp = sub.add_parser("labelvalues")
+    sp = add("labelvalues")
     sp.add_argument("label")
     sp.add_argument("--match", action="append")
     sp.set_defaults(fn=cmd_labelvalues)
-    sp = sub.add_parser("timeseries-metadata")
+    sp = add("timeseries-metadata")
     sp.add_argument("match", nargs="+")
     sp.set_defaults(fn=cmd_series)
-    sp = sub.add_parser("query")
+    sp = add("query")
     sp.add_argument("promql")
     sp.add_argument("--time", type=int)
     sp.set_defaults(fn=cmd_query)
-    sp = sub.add_parser("query-range")
+    sp = add("query-range")
     sp.add_argument("promql")
     sp.add_argument("--start", type=int, required=True)
     sp.add_argument("--end", type=int, required=True)
     sp.add_argument("--step", type=int, default=60)
     sp.set_defaults(fn=cmd_query_range)
-    sp = sub.add_parser("tscard")
+    sp = add("tscard")
     sp.add_argument("--prefix", default="")
     sp.add_argument("--depth", type=int)
     sp.set_defaults(fn=cmd_tscard)
-    sp = sub.add_parser("topkcard")
+    sp = add("topkcard")
     sp.add_argument("--prefix", default="")
     sp.add_argument("-k", type=int, default=10)
     sp.set_defaults(fn=cmd_topkcard)
-    sp = sub.add_parser("find-query-shards")
+    sp = add("find-query-shards")
     sp.add_argument("shard_key_values",
                     help="comma-separated non-metric shard key values")
     sp.add_argument("metric")
     sp.add_argument("--spread", type=int, default=1)
     sp.add_argument("--num-shards", type=int, default=4)
     sp.set_defaults(fn=cmd_find_query_shards)
-    sub.add_parser("validate-schemas").set_defaults(
+    add("validate-schemas").set_defaults(
         fn=cmd_validate_schemas)
-    sp = sub.add_parser("decode-vector")
+    sp = add("decode-vector")
     sp.add_argument("blob", help="file path, hex:<hex>, or b64:<base64>")
     sp.add_argument("--limit", type=int, default=50)
     sp.set_defaults(fn=cmd_decode_vector)
-    sp = sub.add_parser("decode-chunk-info")
+    sp = add("decode-chunk-info")
     sp.add_argument("data_dir")
     sp.add_argument("--shard", type=int, default=0)
     sp.add_argument("--limit", type=int, default=20)

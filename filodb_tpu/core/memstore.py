@@ -441,6 +441,7 @@ class TimeSeriesShard:
         self.stats = ShardStats()
         # per-group ingestion checkpoint offsets (CheckpointTable semantics)
         self.checkpoints: Dict[int, int] = {}
+        self._resident = 0      # running resident-sample count
         # serializes ODP page-ins (queries arrive from concurrent HTTP
         # threads; page-in rebinds part.chunks — everything else on the
         # read path sees immutable snapshots and needs no lock)
@@ -526,6 +527,7 @@ class TimeSeriesShard:
             got = part.ingest_batch(tss[i:j], [c[i:j] for c in cols])
             if got:
                 n += got
+                self._resident += got
                 last = part.last_timestamp
                 if last is not None:
                     self.index.update_end_time(part.part_id, last)
@@ -647,6 +649,7 @@ class TimeSeriesShard:
                 part._chunk_seq = max(part._chunk_seq, len(part.chunks))
                 part._decode_cache.clear()
                 part._merge_cache.clear()
+            self._resident += sum(c.num_rows for c in infos)
             # bootstrapped shells never saw an ingest row: learn the bucket
             # scheme from the paged-in chunk header
             if infos and part._hist_scheme is None:
@@ -676,7 +679,13 @@ class TimeSeriesShard:
     # -- eviction ---------------------------------------------------------
     def resident_samples(self) -> int:
         """Samples held in memory (encoded chunks + write buffers); ODP
-        shells count 0 (their data lives in the ColumnStore)."""
+        shells count 0 (their data lives in the ColumnStore). O(1):
+        maintained by ingest/eviction/page-in, so the per-flush headroom
+        check doesn't rescan every partition's chunk list."""
+        return self._resident
+
+    def recount_resident(self) -> int:
+        """Full rescan (tests / forensic cross-check of the counter)."""
         n = 0
         for p in self.partitions.values():
             n += sum(c.num_rows for c in p.chunks) + len(p._ts_buf)
@@ -743,6 +752,7 @@ class TimeSeriesShard:
                     self.index.start_time(pid)
                     or part.earliest_timestamp or 0,
                     part.last_timestamp or 0))
+                self._resident -= sum(c.num_rows for c in part.chunks)
                 with part._cache_lock:
                     # flag BEFORE clearing: a concurrent lookup must either
                     # see the data or see the page-in flag, never an empty
@@ -766,6 +776,8 @@ class TimeSeriesShard:
         else:
             for pid in evict:
                 part = self.partitions.pop(pid)
+                self._resident -= sum(c.num_rows for c in part.chunks) \
+                    + len(part._ts_buf)
                 self._by_part_key.pop(part.part_key.to_bytes(), None)
                 if self.card_tracker is not None:
                     self.card_tracker.modify_count(

@@ -23,7 +23,13 @@ from filodb_tpu.memory import vectors as bv
 
 class FlushDownsampler:
     """Per-shard flush-time downsampler writing into the derived
-    ``<dataset>_ds_<res>`` datasets of the same ColumnStore."""
+    ``<dataset>_ds_<res>`` datasets of the same ColumnStore.
+
+    NOTE: downsample/job.py implements the same per-period semantics as
+    DEVICE kernels for whole-history batches; this host path handles one
+    small chunk at a time. tests/test_flush_downsample.py and
+    tests/test_downsample.py pin both to the same raw-parity oracle, so
+    a semantic change to one that misses the other fails tests."""
 
     def __init__(self, column_store, dataset: str, shard_num: int,
                  schemas: Schemas,
@@ -44,6 +50,10 @@ class FlushDownsampler:
             sh = self._shard_cls(DatasetRef(name), self.schemas,
                                  self.shard_num,
                                  column_store=self.store)
+            # recover per-series end times: crash-recovery replay re-emits
+            # the same ds rows and the OOO guard drops them — the same
+            # idempotency story as the raw tier
+            sh.bootstrap_from_store()
             self._out[name] = sh
         return sh
 
@@ -115,6 +125,10 @@ class FlushDownsampler:
 
     # -- persistence ------------------------------------------------------
     def flush(self) -> None:
-        """Persist emitted ds chunks (called after the raw flush group)."""
+        """Persist emitted ds chunks (called after the raw flush group),
+        then release them from memory — the ds tier is READ from the
+        ColumnStore (DownsampledTimeSeriesStore pages it in), so keeping
+        a second in-memory copy would only grow without bound."""
         for sh in self._out.values():
             sh.flush_all()
+            sh.evict_partitions(cutoff_ts=1 << 62)

@@ -49,7 +49,10 @@ class FiloHttpServer:
                  query_limits: Optional[QueryLimits] = None,
                  spread_provider: Optional[object] = None,
                  node_id: Optional[str] = None,
-                 peers: Optional[Dict[str, str]] = None):
+                 peers: Optional[Dict[str, str]] = None,
+                 buddies: Optional[Dict[str, str]] = None,
+                 partitions: Optional[Dict[str, str]] = None,
+                 local_partitions: Optional[List[str]] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -63,6 +66,9 @@ class FiloHttpServer:
         # + peer node_id -> base URL for leaf dispatch and metadata fan-out
         self.node_id = node_id
         self.peers = dict(peers or {})
+        self.buddies = dict(buddies or {})
+        self.partitions = dict(partitions or {})
+        self.local_partitions = list(local_partitions or ())
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -148,9 +154,11 @@ class FiloHttpServer:
         if shards is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         # dispatch=local: a forwarded query must evaluate on this node's
-        # shards only (no fan-back-out; loop prevention for pushdown)
-        peers = {} if self._param(qs, "dispatch") == "local" \
-            else self.peers
+        # shards only (no fan-back-out; loop prevention for pushdown —
+        # federation forwarding is likewise disabled)
+        local_dispatch = self._param(qs, "dispatch") == "local"
+        peers = {} if local_dispatch else self.peers
+        partitions = {} if local_dispatch else self.partitions
         engine = QueryPlanner(shards, backend=self.backend,
                               shard_mapper=self.shard_mapper,
                               mesh_executor=self.mesh_executor,
@@ -160,6 +168,9 @@ class FiloHttpServer:
                               limits=self.query_limits,
                               spread_provider=self.spread_provider,
                               node_id=self.node_id, peers=peers,
+                              buddies=self.buddies,
+                              partitions=partitions,
+                              local_partitions=self.local_partitions,
                               dataset=ds)
         if rest == "query_range":
             return self._query_range(engine, qs)
@@ -181,6 +192,7 @@ class FiloHttpServer:
         return v[0] if v else default
 
     def _query_range(self, engine, qs):
+        import time as _time
         query = self._param(qs, "query")
         if not query:
             raise QueryError("missing query parameter")
@@ -189,13 +201,26 @@ class FiloHttpServer:
         step = int(float(self._param(qs, "step", "10")))
         if end < start:
             raise QueryError("end < start")
+        # query-path spans (the Kamon span surface, QueryActor.scala:113:
+        # parse -> materialize -> execute timings ride the response stats)
+        t0 = _time.perf_counter()
         plan = parse_query_range(query, TimeStepParams(start, step, end))
-        res = engine.execute(plan)
+        t1 = _time.perf_counter()
+        ex = engine.materialize(plan)
+        t2 = _time.perf_counter()
+        res = ex.execute()
+        t3 = _time.perf_counter()
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
         out = prom_json.matrix(
             res, hist_wire=bool(self._param(qs, "hist-wire")))
         out["stats"] = self._query_stats(engine, res)
+        out["stats"]["timings"] = {
+            "parseMs": round((t1 - t0) * 1000, 3),
+            "planMs": round((t2 - t1) * 1000, 3),
+            "execMs": round((t3 - t2) * 1000, 3),
+            "plan": type(ex).__name__,
+        }
         return 200, out
 
     def _query_instant(self, engine, qs):

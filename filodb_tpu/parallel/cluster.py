@@ -153,7 +153,8 @@ class PromQlRemoteExec:
 
     def __init__(self, query: str, start_ms: int, step_ms: int,
                  end_ms: int, node_id: str, base_url: str, dataset: str,
-                 timeout_s: float = 60.0, stats=None):
+                 timeout_s: float = 60.0, stats=None,
+                 local_only: bool = True):
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -163,6 +164,10 @@ class PromQlRemoteExec:
         self.dataset = dataset
         self.timeout_s = timeout_s
         self.stats = stats      # planner QueryStats: peer stats fold in
+        # pushdown within a cluster pins the peer to its local shards;
+        # cross-cluster federation lets the remote cluster plan freely
+        # (MultiPartitionPlanner semantics)
+        self.local_only = local_only
 
     def execute(self):
         import urllib.parse
@@ -179,7 +184,8 @@ class PromQlRemoteExec:
                   "end": self.end_ms // 1000,
                   "step": self.step_ms // 1000}
             path = "query_range"
-        qs["dispatch"] = "local"    # peer must not fan back out (no loops)
+        if self.local_only:
+            qs["dispatch"] = "local"    # no fan-back-out (loop prevention)
         qs["hist-wire"] = "1"
         url = (f"{self.base_url}/promql/{self.dataset}/api/v1/{path}?"
                + urllib.parse.urlencode(qs))

@@ -433,6 +433,9 @@ class QueryPlanner:
                  spread_provider: Optional[object] = None,
                  node_id: Optional[str] = None,
                  peers: Optional[Dict[str, str]] = None,
+                 buddies: Optional[Dict[str, str]] = None,
+                 partitions: Optional[Dict[str, str]] = None,
+                 local_partitions: Optional[Sequence[str]] = None,
                  dataset: str = "timeseries"):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
@@ -457,6 +460,17 @@ class QueryPlanner:
         # (FiloDbClusterDiscovery.scala:50 / PlanDispatcher.scala:21)
         self.node_id = node_id
         self.peers = dict(peers or {})
+        # HA replica cluster: node_id -> buddy base URL holding the same
+        # shard layout; DOWN shards route there instead of dropping out
+        # (HighAvailabilityPlanner.scala:31,285 / BuddyShardMapper)
+        self.buddies = dict(buddies or {})
+        # cross-cluster federation: workspace (_ws_) value -> base URL of
+        # the cluster owning that partition (MultiPartitionPlanner.scala:53
+        # / SinglePartitionPlanner.scala:17 — pick the cluster by key and
+        # forward the whole query; the remote cluster plans freely)
+        self.partitions = dict(partitions or {})
+        # workspaces THIS cluster serves; never forwarded (self-loop guard)
+        self.local_partitions = frozenset(local_partitions or ())
         self.dataset = dataset
         self.stats = QueryStats()
 
@@ -506,10 +520,25 @@ class QueryPlanner:
             nums = sorted(self._by_num) if not self.peers else \
                 list(range(self.mapper.num_shards)) if self.mapper \
                 else sorted(self._by_num)
+        down: List[int] = []
         if self.mapper is not None:
             ok = set(self.mapper.active_shards(nums))
+            down = [n for n in nums if n not in ok]
             nums = [n for n in nums if n in ok]
         local = [self._by_num[n] for n in nums if n in self._by_num]
+        if down and self.buddies:
+            # failover: serve a down shard from the buddy replica of its
+            # owning node (the replica ingests the same stream)
+            from filodb_tpu.parallel.cluster import RemoteShardGroup
+            by_buddy: Dict[str, List[int]] = {}
+            for n in down:
+                node = self.mapper.node_of(n)
+                url = self.buddies.get(node or "")
+                if url:
+                    by_buddy.setdefault(url, []).append(n)
+            for i, (url, group) in enumerate(sorted(by_buddy.items())):
+                local.append(RemoteShardGroup(f"buddy:{url}", url,
+                                              self.dataset, group))
         if not self.peers or self.mapper is None:
             return local
         # group non-local shard numbers by their owning peer node
@@ -530,10 +559,13 @@ class QueryPlanner:
 
     # -- materialization -------------------------------------------------
     def materialize(self, plan) -> ExecPlan:
-        """(SingleClusterPlanner.scala:253). Raw/downsample tiering first
-        (LongTimeRangePlanner), then pattern-matches the mesh-lowerable
-        aggregate shape; everything else runs locally over the pruned
-        shard subset."""
+        """(SingleClusterPlanner.scala:253). Cross-cluster partition
+        routing first, then raw/downsample tiering (LongTimeRangePlanner),
+        then the mesh-lowerable aggregate shape; everything else runs
+        locally over the pruned shard subset."""
+        fed = self._try_partition_routing(plan)
+        if fed is not None:
+            return fed
         tiered = self._try_tiering(plan)
         if tiered is not None:
             return tiered
@@ -565,6 +597,24 @@ class QueryPlanner:
         nodes = {s.node_id for s in shards}
         if len(nodes) != 1:
             return None
+        fw = self._forwardable(plan)
+        if fw is None:
+            return None
+        query, start, step, end = fw
+        from filodb_tpu.parallel.cluster import PromQlRemoteExec
+        g = shards[0]
+        return PromQlRemoteExec(query, start, step, end, g.node_id,
+                                g.base_url, g.dataset, stats=self.stats)
+
+    def execute(self, plan):
+        return self.materialize(plan).execute()
+
+    def _forwardable(self, plan):
+        """(query_text, start, step, end) when the whole plan can ride the
+        HTTP edge to another node/cluster, else None — shared eligibility
+        for pushdown and federation."""
+        if lp.is_metadata_plan(plan) or lp.is_scalar_plan(plan):
+            return None
         rng = plan_range(plan)
         if rng is None:
             return None
@@ -575,13 +625,39 @@ class QueryPlanner:
         query = plan_to_promql(plan)
         if query is None:
             return None
-        from filodb_tpu.parallel.cluster import PromQlRemoteExec
-        g = shards[0]
-        return PromQlRemoteExec(query, start, step, end, g.node_id,
-                                g.base_url, g.dataset, stats=self.stats)
+        return query, start, step, end
 
-    def execute(self, plan):
-        return self.materialize(plan).execute()
+    def _try_partition_routing(self, plan) -> Optional[ExecPlan]:
+        """Forward a query whose every leaf pins _ws_ to ONE remote
+        partition's cluster (SinglePartitionPlanner: cluster by key).
+        Workspaces this cluster serves itself are never forwarded."""
+        if not self.partitions:
+            return None
+        if lp.is_metadata_plan(plan) or lp.is_scalar_plan(plan):
+            return None
+        ws_values = set()
+        for filters in walk_leaf_filters(plan):
+            got = [f.value for f in filters
+                   if f.label == "_ws_" and f.op == "eq"]
+            if len(got) != 1:
+                return None     # unpinned / multi: local planning
+            ws_values.add(got[0])
+        if len(ws_values) != 1:
+            return None         # cross-partition joins stay local
+        ws = ws_values.pop()
+        if ws in self.local_partitions:
+            return None         # our own partition: plan locally
+        url = self.partitions.get(ws)
+        if not url:
+            return None
+        fw = self._forwardable(plan)
+        if fw is None:
+            return None
+        query, start, step, end = fw
+        from filodb_tpu.parallel.cluster import PromQlRemoteExec
+        return PromQlRemoteExec(query, start, step, end,
+                                f"partition:{url}", url, self.dataset,
+                                stats=self.stats, local_only=False)
 
     # -- raw/downsample tiering (LongTimeRangePlanner.scala:30) -----------
     def _earliest_raw_ms(self) -> int:

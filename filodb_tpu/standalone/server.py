@@ -74,6 +74,15 @@ DEFAULTS = {
     "num-nodes": 1,
     "node-ordinal": 0,
     "peers": {},
+    # HA buddy replica cluster (HighAvailabilityPlanner.scala:31): maps a
+    # node id to the SAME-ordinal node of a replica cluster ingesting the
+    # same streams; queries route a DOWN node's shards to its buddy
+    "buddy-peers": {},
+    # cross-cluster federation: _ws_ value -> base URL of the cluster
+    # owning that workspace (MultiPartitionPlanner.scala:53); workspaces
+    # in local-partitions are served here and never forwarded
+    "partitions": {},
+    "local-partitions": [],
     # per-shard-key spread overrides {"ws,ns": spread}
     # (core/SpreadProvider.scala; doc/sharding.md "Spread")
     "spread-overrides": {},
@@ -200,7 +209,11 @@ class FiloServer:
                 series_limit=int(self.config.get("query-series-limit", 0)),
                 sample_limit=int(self.config.get("query-sample-limit", 0))),
             spread_provider=self.spread_provider,
-            node_id=self.node_id, peers=peers)
+            node_id=self.node_id, peers=peers,
+            buddies=dict(self.config.get("buddy-peers") or {}),
+            partitions=dict(self.config.get("partitions") or {}),
+            local_partitions=list(
+                self.config.get("local-partitions") or ()))
         self.http.start()
         if peers:
             from filodb_tpu.parallel.cluster import FailureDetector

@@ -90,7 +90,7 @@ def test_headroom_eviction(tmp_path):
         shard.ingest(c)
     shard.flush_all(offset=1)
     before = shard.resident_samples()
-    assert before == 2000
+    assert before == 2000 == shard.recount_resident()
     evicted = shard.ensure_headroom(max_samples=1000)
     assert evicted > 0
     after = shard.resident_samples()
@@ -99,5 +99,6 @@ def test_headroom_eviction(tmp_path):
     tsp = TimeStepParams(T0 // 1000, 600, T0 // 1000 + 2_000 * 10)
     out = QueryEngine([shard]).execute(parse_query_range("m", tsp))
     assert out.num_series == 10
+    assert shard.resident_samples() == shard.recount_resident()
     # under budget: no-op
     assert shard.ensure_headroom(max_samples=10_000_000) == 0

@@ -191,6 +191,54 @@ def test_cross_node_query_and_peer_death(cluster):
     assert partial < all0
 
 
+def test_buddy_failover_serves_down_shards(tmp_path):
+    """HA: a DOWN node's shards are served from its buddy replica
+    (HighAvailabilityPlanner.scala:31 — route failed shards to the buddy
+    cluster), so results stay COMPLETE through a node loss."""
+    p0, p1, pb = _free_port(), _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "seed-dev-data": True, "seed-start-ms": T0 * 1000,
+        "seed-samples": N_SAMPLES, "seed-instances": N_INSTANCES,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": 0.25,
+    }
+    procs = []
+    try:
+        procs.append(_spawn({**base, "node-ordinal": 0, "port": p0,
+                             "buddy-peers": {
+                                 "node1": f"http://127.0.0.1:{pb}"}},
+                            tmp_path, "node0"))
+        procs.append(_spawn({**base, "node-ordinal": 1, "port": p1},
+                            tmp_path, "node1"))
+        # the buddy replica of node1: same ordinal/shard layout, same
+        # (deterministically seeded) data, no cluster peers of its own
+        procs.append(_spawn({**base, "node-ordinal": 1, "port": pb,
+                             "peers": {}},
+                            tmp_path, "node1-buddy"))
+        for p in procs:
+            _wait_ready(p)
+        full = _poll(lambda: ((lambda s: (len(s) > 0, s))(
+            _series_instances(p0))))
+
+        os.kill(procs[1].pid, signal.SIGKILL)
+        procs[1].wait(timeout=30)
+        _poll(lambda: ((lambda b: (any(
+            s["status"] == "down" for s in b["data"]), b))(
+            _get(p0, "/api/v1/cluster/timeseries/status"))), timeout=30)
+
+        # with the buddy configured, results stay COMPLETE
+        after = _series_instances(p0)
+        assert after == full
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
 def test_peer_recovery_restores_shards(cluster, tmp_path):
     p0, p1, procs = cluster
     _poll(lambda: ((lambda s: (len(s) > 0, s))(_series_instances(p0))))

@@ -48,6 +48,9 @@ def test_metrics_and_query_stats():
         assert st["seriesScanned"] == 3
         assert st["samplesScanned"] > 0
         assert st["resultBytes"] > 0
+        # query-path spans (parse/plan/exec) ride the stats
+        tm = st["timings"]
+        assert tm["execMs"] >= 0 and tm["plan"]
 
         # tile cache counters move once the backend served a query
         _, text2 = _get_text(srv.port, "/metrics")
