@@ -734,7 +734,11 @@ class TimeSeriesShard:
         evict = [
             pid for pid, p in self.partitions.items()
             if (p.last_timestamp is not None and p.last_timestamp < cutoff_ts
-                and not p._ts_buf and not p.odp_pending)
+                and not p._ts_buf
+                # shells that re-accumulated chunks (resumed ingest after
+                # an earlier eviction) are evictable again; empty shells
+                # have nothing to release
+                and (p.chunks or not p.odp_pending))
         ]
         if self.column_store is not None:
             from filodb_tpu.store import PartKeyEntry

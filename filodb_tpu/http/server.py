@@ -35,6 +35,10 @@ from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
 
 
+class _Handled(Exception):
+    """Control-flow: response (code, payload) already decided."""
+
+
 class FiloHttpServer:
     """Serves one or more datasets; each maps to a list of shards."""
 
@@ -101,23 +105,37 @@ class FiloHttpServer:
             parsed = urllib.parse.urlparse(req.path)
             qs = urllib.parse.parse_qs(parsed.query)
             body_json = None
+            body_raw = b""
             if req.command == "POST":
                 ln = int(req.headers.get("Content-Length") or 0)
-                body = req.rfile.read(ln).decode() if ln else ""
+                if ln > (64 << 20):     # request-size cap (DoS guard)
+                    code, payload = 413, prom_json.error(
+                        "request body too large")
+                    raise _Handled()
+                body_raw = req.rfile.read(ln) if ln else b""
                 ctype = req.headers.get("Content-Type", "")
                 if "application/x-www-form-urlencoded" in ctype:
-                    for k, v in urllib.parse.parse_qs(body).items():
+                    for k, v in urllib.parse.parse_qs(
+                            body_raw.decode()).items():
                         qs.setdefault(k, []).extend(v)
-                elif "application/json" in ctype and body:
-                    body_json = json.loads(body)
-            code, payload = self._route(parsed.path, qs, body_json)
+                elif "application/json" in ctype and body_raw:
+                    body_json = json.loads(body_raw)
+            code, payload = self._route(parsed.path, qs, body_json,
+                                        body_raw)
+        except _Handled:
+            pass
         except QueryLimitError as e:
             code, payload = 422, prom_json.error(str(e), "query_limit")
         except QueryError as e:
             code, payload = 400, prom_json.error(str(e))
         except Exception as e:   # noqa: BLE001 — edge must not crash
             code, payload = 500, prom_json.error(str(e), "internal")
-        if isinstance(payload, str):    # /metrics exposition text
+        extra_headers = {}
+        if isinstance(payload, bytes):  # remote-read protobuf
+            body = payload
+            ctype = "application/x-protobuf"
+            extra_headers["Content-Encoding"] = "snappy"
+        elif isinstance(payload, str):  # /metrics exposition text
             body = payload.encode()
             ctype = "text/plain; version=0.0.4"
         else:
@@ -125,11 +143,14 @@ class FiloHttpServer:
             ctype = "application/json"
         req.send_response(code)
         req.send_header("Content-Type", ctype)
+        for k, v in extra_headers.items():
+            req.send_header(k, v)
         req.send_header("Content-Length", str(len(body)))
         req.end_headers()
         req.wfile.write(body)
 
-    def _route(self, path: str, qs: Dict, body_json=None):
+    def _route(self, path: str, qs: Dict, body_json=None,
+               body_raw: bytes = b""):
         if path in ("/__health", "/__liveness", "/__readiness"):
             return 200, {"status": "healthy"}
         if path == "/metrics":
@@ -183,6 +204,8 @@ class FiloHttpServer:
             return self._label_values(engine, lm.group("name"), qs, ds)
         if rest == "series":
             return self._series(engine, qs, ds)
+        if rest == "read":
+            return self._remote_read(ds, body_raw)
         return 404, prom_json.error(f"no route for {path}", "not_found")
 
     # -- endpoints --------------------------------------------------------
@@ -473,3 +496,51 @@ class FiloHttpServer:
             out.update(tuple(sorted(d.items())) if isinstance(d, dict)
                        else d for d in payload["data"])
         return out
+
+    # -- Prometheus remote-read -------------------------------------------
+    def _remote_read(self, ds: str, body_raw: bytes):
+        """POST /promql/{ds}/api/v1/read: snappy(ReadRequest protobuf) ->
+        snappy(ReadResponse) (remote-storage.proto;
+        PrometheusApiRoute.scala:129)."""
+        from filodb_tpu.core.index import ColumnFilter
+        from filodb_tpu.http import remote_read as rr
+        from filodb_tpu.query.engine import select_raw_series
+        from filodb_tpu.query.model import QueryStats
+        from filodb_tpu.query import logical as lp2
+        shards = self.shards_by_dataset.get(ds)
+        if shards is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
+        if not body_raw:
+            return 400, prom_json.error("missing remote-read body")
+        try:
+            queries = rr.decode_read_request(
+                rr.snappy_decompress(body_raw))
+        except (ValueError, IndexError) as e:
+            raise QueryError(f"bad remote-read request: {e}")
+        # resolve through the planner so cluster peers / buddy replicas
+        # serve their shards — same coverage as /query_range
+        planner = QueryPlanner(shards, shard_mapper=self.shard_mapper,
+                               spread=self.spread,
+                               spread_provider=self.spread_provider,
+                               limits=self.query_limits,
+                               node_id=self.node_id, peers=self.peers,
+                               buddies=self.buddies, dataset=ds)
+        results = []
+        for q in queries:
+            filters = [ColumnFilter(n, op, v)
+                       for n, op, v in q["matchers"]]
+            plan = lp2.RawSeriesPlan(tuple(filters), q["start_ms"],
+                                     q["end_ms"])
+            series = select_raw_series(
+                planner._resolve_shards(plan), filters,
+                q["start_ms"], q["end_ms"], None,
+                QueryStats(), limits=self.query_limits)
+            out = []
+            for s in series:
+                if s.values.ndim != 1:
+                    continue    # histograms have no remote-read shape
+                samples = [(int(t), float(v))
+                           for t, v in zip(s.ts, s.values)]
+                out.append((dict(s.labels), samples))
+            results.append(out)
+        return 200, rr.snappy_compress(rr.encode_read_response(results))

@@ -102,3 +102,27 @@ def test_headroom_eviction(tmp_path):
     assert shard.resident_samples() == shard.recount_resident()
     # under budget: no-op
     assert shard.ensure_headroom(max_samples=10_000_000) == 0
+
+
+def test_flush_downsampler_memory_bounded(tmp_path):
+    """Regression: ds-tier chunks must be released from memory on EVERY
+    flush round, not just the first (shells that re-accumulate chunks
+    stay evictable)."""
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, column_store=cs,
+                            max_chunk_rows=60)
+    fds = FlushDownsampler(cs, "timeseries", 0, DEFAULT_SCHEMAS,
+                           resolutions=(RES,))
+    shard.flush_downsampler = fds
+    for round_no in range(4):
+        b = RecordBuilder(DEFAULT_SCHEMAS)
+        labels = {"_metric_": "cpu", "_ws_": "w", "_ns_": "n"}
+        for t in range(60):
+            b.add_sample("gauge", labels,
+                         T0 + OFF + (round_no * 60 + t) * 10_000,
+                         float(t))
+        for c in b.containers():
+            shard.ingest(c)
+        shard.flush_all(offset=round_no + 1)
+        for sh in fds._out.values():
+            assert sh.resident_samples() == 0, round_no

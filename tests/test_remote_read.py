@@ -1,0 +1,112 @@
+"""Prometheus remote-read: snappy codec, protobuf wire, HTTP endpoint.
+
+(remote-storage.proto + PrometheusApiRoute.scala:129.)
+"""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.http import remote_read as rr
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+
+# --- snappy ---------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [0, 1, 59, 60, 61, 255, 256, 70_000])
+def test_snappy_roundtrip_sizes(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+    assert rr.snappy_decompress(rr.snappy_compress(data)) == data
+
+
+def test_snappy_decompress_copies():
+    """Hand-built compressed stream with all three copy tags (a real
+    compressor emits these; our decompressor must handle them)."""
+    # "abcdabcdabcdXY" via literal 'abcd' + copy(offset=4, len=8) + 'XY'
+    out = bytearray()
+    out += bytes([14])                          # uvarint ulen=14
+    out += bytes([(4 - 1) << 2]) + b"abcd"      # literal len 4
+    out += bytes([((8 - 4) << 2) | 1, 4])      # copy1: len=8, offset=4
+    out += bytes([(2 - 1) << 2]) + b"XY"        # literal 'XY'
+    assert rr.snappy_decompress(bytes(out)) == b"abcdabcdabcdXY"
+    # copy2 form
+    out2 = bytearray()
+    out2 += bytes([8])
+    out2 += bytes([(4 - 1) << 2]) + b"wxyz"
+    out2 += bytes([((4 - 1) << 2) | 2]) + (4).to_bytes(2, "little")
+    assert rr.snappy_decompress(bytes(out2)) == b"wxyzwxyz"
+
+
+# --- protobuf wire --------------------------------------------------------
+
+def test_read_request_roundtrip():
+    queries = [{"start_ms": T0 * 1000, "end_ms": (T0 + 600) * 1000,
+                "matchers": [("__name__", "eq", "cpu"),
+                             ("instance", "re", "i.*"),
+                             ("dc", "neq", "east")]}]
+    buf = rr.encode_read_request(queries)
+    assert rr.decode_read_request(buf) == queries
+
+
+def test_read_response_roundtrip():
+    results = [[({"__name__": "cpu", "instance": "i0"},
+                 [(T0 * 1000, 1.5), (T0 * 1000 + 10_000, -2.25)])],
+               []]
+    buf = rr.encode_read_response(results)
+    got = rr.decode_read_response(buf)
+    assert got == [[({"__name__": "cpu", "instance": "i0"},
+                     [(T0 * 1000, 1.5), (T0 * 1000 + 10_000, -2.25)])],
+                   []]
+
+
+# --- endpoint -------------------------------------------------------------
+
+def test_remote_read_endpoint():
+    srv = FiloServer({"num-shards": 2, "port": 0}).start()
+    try:
+        srv.seed_dev_data(n_samples=30, n_instances=2,
+                          start_ms=T0 * 1000)
+        req_body = rr.snappy_compress(rr.encode_read_request([{
+            "start_ms": T0 * 1000,
+            "end_ms": (T0 + 300) * 1000,
+            "matchers": [("_metric_", "eq", "heap_usage")],
+        }]))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/read",
+            data=req_body,
+            headers={"Content-Type": "application/x-protobuf",
+                     "Content-Encoding": "snappy"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers["Content-Type"] == "application/x-protobuf"
+            assert r.headers["Content-Encoding"] == "snappy"
+            payload = r.read()
+        results = rr.decode_read_response(rr.snappy_decompress(payload))
+        assert len(results) == 1
+        series = results[0]
+        assert len(series) == 2                 # two instances
+        for labels, samples in series:
+            assert labels["_metric_"] == "heap_usage"
+            assert len(samples) == 30
+            ts = [t for t, _ in samples]
+            assert ts == sorted(ts)
+    finally:
+        srv.stop()
+
+
+def test_snappy_bomb_rejected():
+    """A tiny body declaring a huge output must be rejected up front."""
+    bomb = bytearray()
+    n = 1 << 40
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        bomb.append(b | (0x80 if n else 0))
+        if not n:
+            break
+    bomb += bytes([0]) + b"x"
+    with pytest.raises(ValueError, match="limit"):
+        rr.snappy_decompress(bytes(bomb))
