@@ -743,29 +743,34 @@ class TimeSeriesShard:
         if self.column_store is not None:
             from filodb_tpu.store import PartKeyEntry
             entries = []
-            for pid in evict:
-                part = self.partitions[pid]
-                new = part.chunks[part.persisted_chunks:]
-                if new:
-                    self.column_store.write_chunks(
-                        self.ref.dataset, self.shard_num,
-                        part.part_key.to_bytes(), new)
-                    self.stats.chunks_persisted += len(new)
-                entries.append(PartKeyEntry(
-                    part.part_key.to_bytes(),
-                    self.index.start_time(pid)
-                    or part.earliest_timestamp or 0,
-                    part.last_timestamp or 0))
-                self._resident -= sum(c.num_rows for c in part.chunks)
-                with part._cache_lock:
-                    # flag BEFORE clearing: a concurrent lookup must either
-                    # see the data or see the page-in flag, never an empty
-                    # unflagged partition
-                    part.odp_pending = True
-                    part.chunks = []
-                    part.persisted_chunks = 0
-                    part._decode_cache.clear()
-                    part._merge_cache.clear()
+            # hold the ODP lock for the persist+clear: a concurrent
+            # _ensure_loaded page-in snapshotting chunks mid-eviction
+            # could otherwise clear odp_pending with the just-evicted
+            # chunks missing — silent permanent data loss until restart
+            with self._odp_lock:
+                for pid in evict:
+                    part = self.partitions[pid]
+                    new = part.chunks[part.persisted_chunks:]
+                    if new:
+                        self.column_store.write_chunks(
+                            self.ref.dataset, self.shard_num,
+                            part.part_key.to_bytes(), new)
+                        self.stats.chunks_persisted += len(new)
+                    entries.append(PartKeyEntry(
+                        part.part_key.to_bytes(),
+                        self.index.start_time(pid)
+                        or part.earliest_timestamp or 0,
+                        part.last_timestamp or 0))
+                    self._resident -= sum(c.num_rows for c in part.chunks)
+                    with part._cache_lock:
+                        # flag BEFORE clearing: a concurrent lookup must
+                        # either see the data or see the page-in flag,
+                        # never an empty unflagged partition
+                        part.odp_pending = True
+                        part.chunks = []
+                        part.persisted_chunks = 0
+                        part._decode_cache.clear()
+                        part._merge_cache.clear()
             if entries:
                 self.column_store.write_part_keys(
                     self.ref.dataset, self.shard_num, entries)

@@ -31,11 +31,14 @@ def snappy_decompress(buf: bytes,
     """Full snappy block decompressor (literals + all three copy tags).
     Bounded by ``max_len`` — /read is unauthenticated, so a crafted tiny
     body must not balloon into unbounded memory/CPU."""
-    # preamble: uvarint uncompressed length
+    # preamble: uvarint uncompressed length (<= 5 bytes per snappy spec;
+    # unbounded continuation bytes would be a bigint CPU bomb)
     ulen = 0
     shift = 0
     pos = 0
     while True:
+        if shift > 32:
+            raise ValueError("snappy: preamble varint too long")
         b = buf[pos]
         pos += 1
         ulen |= (b & 0x7F) << shift
@@ -142,6 +145,8 @@ def _read_uvarint(buf: bytes, pos: int) -> Tuple[int, int]:
     v = 0
     shift = 0
     while True:
+        if shift > 63:      # proto varints are <= 10 bytes
+            raise ValueError("protobuf: varint too long")
         b = buf[pos]
         pos += 1
         v |= (b & 0x7F) << shift

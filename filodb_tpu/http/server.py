@@ -527,12 +527,29 @@ class FiloHttpServer:
                                buddies=self.buddies, dataset=ds)
         results = []
         for q in queries:
-            filters = [ColumnFilter(n, op, v)
-                       for n, op, v in q["matchers"]]
+            # Prometheus clients send __name__; our index stores the
+            # metric under the schema's metric column (_metric_), the
+            # same mapping the PromQL parser applies
+            filters = [ColumnFilter(
+                "_metric_" if n == "__name__" else n, op, v)
+                for n, op, v in q["matchers"]]
             plan = lp2.RawSeriesPlan(tuple(filters), q["start_ms"],
                                      q["end_ms"])
+            shard_objs = planner._resolve_shards(plan)
+            # federated workspaces: matchers pinning _ws_ to a partition
+            # another cluster owns read that cluster's raw endpoint (the
+            # same coverage /query_range gets from partition routing)
+            ws = [f.value for f in filters
+                  if f.label == "_ws_" and f.op == "eq"]
+            if ws and self.partitions:
+                url = self.partitions.get(ws[0])
+                if url and ws[0] not in self.local_partitions:
+                    from filodb_tpu.parallel.cluster import \
+                        RemoteShardGroup
+                    shard_objs = [RemoteShardGroup(
+                        f"partition:{url}", url, ds, None)]
             series = select_raw_series(
-                planner._resolve_shards(plan), filters,
+                shard_objs, filters,
                 q["start_ms"], q["end_ms"], None,
                 QueryStats(), limits=self.query_limits)
             out = []

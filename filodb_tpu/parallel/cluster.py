@@ -111,14 +111,17 @@ class RemoteShardGroup:
     leaf-dispatch hop, over HTTP instead of Akka+Kryo."""
 
     def __init__(self, node_id: str, base_url: str, dataset: str,
-                 shard_nums: Sequence[int], timeout_s: float = 60.0):
+                 shard_nums: Optional[Sequence[int]],
+                 timeout_s: float = 60.0):
         self.node_id = node_id
         self.base_url = base_url.rstrip("/")
         self.dataset = dataset
-        self.shard_nums = list(shard_nums)
+        # None = ALL of the peer's shards (cross-cluster raw reads)
+        self.shard_nums = list(shard_nums) if shard_nums is not None \
+            else None
         self.timeout_s = timeout_s
         # planner bookkeeping: a group covers many shard numbers
-        self.shard_num = tuple(self.shard_nums)
+        self.shard_num = tuple(self.shard_nums or ())
 
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],

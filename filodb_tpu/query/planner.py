@@ -25,6 +25,9 @@ DefaultPlanner's aggregate lowering). Differences by design:
 
 from __future__ import annotations
 
+import itertools
+import math
+import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -40,6 +43,24 @@ from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
 
 # aggregations executable as mesh collectives (parallel/mesh.py MESH_AGGS)
 _MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
+
+# a regex that is just literal alternations (no metacharacters beyond |)
+_LITERAL_ALT = re.compile(r"[A-Za-z0-9_\-:, ]+$")
+
+
+def _shard_key_candidates(f: ColumnFilter) -> Optional[List[str]]:
+    """Concrete candidate values a filter pins its label to, or None."""
+    if f.op == "eq":
+        return [f.value]
+    if f.op == "in":
+        vals = f.value if isinstance(f.value, (list, tuple)) \
+            else str(f.value).split(",")
+        return [str(v) for v in vals]
+    if f.op == "re" and "|" in f.value:
+        parts = f.value.split("|")
+        if all(p and _LITERAL_ALT.match(p) for p in parts):
+            return parts
+    return None
 
 
 def walk_plan_tree(plan, visit) -> None:
@@ -478,28 +499,46 @@ class QueryPlanner:
     def shards_from_filters(self, filters: Sequence[ColumnFilter]
                             ) -> Optional[List[int]]:
         """Shard subset for one leaf, or None when filters can't resolve a
-        shard key (fan out to all)."""
+        shard key (fan out to all).
+
+        Shard-key columns matched by a regex of LITERAL ALTERNATIONS
+        (``App-0|App-1``) or an explicit ``in`` list expand into per-value
+        shard sets and union — the ShardKeyRegexPlanner.scala:31 fan-out
+        (the reference likewise only supports | of literals)."""
         if self.mapper is None:
             return None
-        eqs = {f.label: f.value for f in filters if f.op == "eq"}
-        metric = None
+        by_label: Dict[str, List[str]] = {}
+        for f in filters:
+            vals = _shard_key_candidates(f)
+            if vals is not None and f.label not in by_label:
+                by_label[f.label] = vals
+        metric_vals = None
         for ml in (self.metric_column,) + METRIC_LABELS:
-            if ml in eqs:
-                metric = eqs[ml]
+            if ml in by_label:
+                metric_vals = by_label[ml]
                 break
-        if metric is None:
+        if metric_vals is None:
             return None
-        values = []
-        for c in self.shard_key_columns:
-            if c == self.metric_column:
-                continue
-            if c not in eqs:
+        key_cols = [c for c in self.shard_key_columns
+                    if c != self.metric_column]
+        per_col = []
+        for c in key_cols:
+            if c not in by_label:
                 return None
-            values.append(eqs[c])
-        skh = shard_key_hash(values, metric)
-        spread = self.spread_provider.spread_for(values) \
-            if self.spread_provider is not None else self.spread
-        return self.mapper.query_shards(skh, spread)
+            per_col.append(by_label[c])
+        # cartesian fan-out over the candidate key tuples (bounded small;
+        # math.prod: exact Python ints — np.prod would wrap at 2^64 and
+        # could sneak a huge fan-out past the cap)
+        if math.prod(len(v) for v in per_col + [metric_vals]) > 256:
+            return None     # oversized fan-out: just use all shards
+        nums: set = set()
+        for combo in itertools.product(*per_col):
+            spread = self.spread_provider.spread_for(list(combo)) \
+                if self.spread_provider is not None else self.spread
+            for metric in metric_vals:
+                skh = shard_key_hash(list(combo), metric)
+                nums.update(self.mapper.query_shards(skh, spread))
+        return sorted(nums)
 
     def _resolve_shards(self, plan) -> List[object]:
         """Union of pruned shard subsets across all leaves; all shards when

@@ -73,7 +73,7 @@ def test_remote_read_endpoint():
         req_body = rr.snappy_compress(rr.encode_read_request([{
             "start_ms": T0 * 1000,
             "end_ms": (T0 + 300) * 1000,
-            "matchers": [("_metric_", "eq", "heap_usage")],
+            "matchers": [("__name__", "eq", "heap_usage")],
         }]))
         req = urllib.request.Request(
             f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/read",
@@ -108,5 +108,9 @@ def test_snappy_bomb_rejected():
         if not n:
             break
     bomb += bytes([0]) + b"x"
-    with pytest.raises(ValueError, match="limit"):
+    with pytest.raises(ValueError, match="limit|too long"):
         rr.snappy_decompress(bytes(bomb))
+    # a 5-byte varint within spec but over the byte limit also rejects
+    big = rr.snappy_compress(b"x" * 100)
+    with pytest.raises(ValueError, match="limit"):
+        rr.snappy_decompress(big, max_len=10)

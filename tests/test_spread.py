@@ -63,3 +63,44 @@ def test_planner_uses_provider(tmp_path):
     n_cold = len(planner.shards_from_filters(cold.raw.filters))
     n_hot = len(planner.shards_from_filters(hot.raw.filters))
     assert n_cold == 1 and n_hot == 2
+
+
+def test_regex_shard_key_fanout():
+    """ShardKeyRegexPlanner.scala:31: literal-alternation regex /
+    in-lists on shard-key columns prune to the union of per-value shard
+    sets instead of fanning to all shards."""
+    from filodb_tpu.core.index import ColumnFilter
+    from filodb_tpu.core.record import query_shards
+    from filodb_tpu.parallel.shardmapper import (ShardMapper,
+                                                 assign_shards_evenly)
+    from filodb_tpu.query.planner import QueryPlanner
+    mapper = ShardMapper(16)
+    assign_shards_evenly(mapper, ["n0"])
+    for i in range(16):
+        mapper.activate(i)
+    planner = QueryPlanner([], shard_mapper=mapper, spread=0)
+    f = [ColumnFilter("_metric_", "eq", "cpu"),
+         ColumnFilter("_ws_", "eq", "demo"),
+         ColumnFilter("_ns_", "re", "App-0|App-1|App-2")]
+    got = planner.shards_from_filters(f)
+    want = set()
+    for ns in ("App-0", "App-1", "App-2"):
+        want.update(query_shards(shard_key_hash(["demo", ns], "cpu"),
+                                 0, 16))
+    assert got == sorted(want)
+    assert 0 < len(got) < 16
+    # true regex (metacharacters) still fans out to all shards
+    f2 = [ColumnFilter("_metric_", "eq", "cpu"),
+          ColumnFilter("_ws_", "eq", "demo"),
+          ColumnFilter("_ns_", "re", "App-.*")]
+    assert planner.shards_from_filters(f2) is None
+    # metric alternation works too
+    f3 = [ColumnFilter("_metric_", "re", "cpu|mem"),
+          ColumnFilter("_ws_", "eq", "demo"),
+          ColumnFilter("_ns_", "eq", "App-0")]
+    got3 = planner.shards_from_filters(f3)
+    want3 = set()
+    for m in ("cpu", "mem"):
+        want3.update(query_shards(shard_key_hash(["demo", "App-0"], m),
+                                  0, 16))
+    assert got3 == sorted(want3)
