@@ -522,7 +522,10 @@ class TimeSeriesShard:
                 # not trigger a full-retention read storm
                 endt = self.index.end_time(part.part_id)
                 if endt is not None and endt != END_TIME_INGESTING \
-                        and tss[i] <= endt:
+                        and min(tss[i:j]) <= endt:
+                    # min of the whole run, not just the first row: an
+                    # unsorted replay run may lead with a fresh row while
+                    # later rows still overlap persisted history
                     self._ensure_loaded(part)
             got = part.ingest_batch(tss[i:j], [c[i:j] for c in cols])
             if got:

@@ -347,8 +347,13 @@ class FiloHttpServer:
         import dataclasses as _dc
         lines: List[str] = []
 
+        def esc(v):
+            # Prometheus text-format label escaping: \ " and newline
+            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                .replace("\n", "\\n")
+
         def emit(name, labels, value):
-            lbl = ",".join(f'{k}="{v}"' for k, v in labels.items())
+            lbl = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
             lines.append(f"filodb_{name}{{{lbl}}} {value}")
 
         for ds, shards in self.shards_by_dataset.items():
@@ -558,6 +563,8 @@ class FiloHttpServer:
                     continue    # histograms have no remote-read shape
                 samples = [(int(t), float(v))
                            for t, v in zip(s.ts, s.values)]
-                out.append((dict(s.labels), samples))
+                # external label form: _metric_ -> __name__ (same
+                # mapping as the JSON path)
+                out.append((prom_json._metric(dict(s.labels)), samples))
             results.append(out)
         return 200, rr.snappy_compress(rr.encode_read_response(results))

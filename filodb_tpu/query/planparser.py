@@ -88,6 +88,13 @@ def _print(plan) -> Optional[str]:
                           plan.at_ms)
         if inner is None:
             return None
+        # predict_linear/holt_winters take their scalars AFTER the range
+        # vector (the parser's RANGE_FN_SCALAR_AFTER table); the rest
+        # (quantile_over_time) take them before
+        from filodb_tpu.promql.parser import RANGE_FN_SCALAR_AFTER
+        if plan.function in RANGE_FN_SCALAR_AFTER:
+            args = "".join(f", {_num(a)}" for a in plan.func_args)
+            return f"{plan.function}({inner}{args})"
         args = "".join(f"{_num(a)}, " for a in plan.func_args)
         return f"{plan.function}({args}{inner})"
     if isinstance(plan, lp.Aggregate):
@@ -140,8 +147,14 @@ def _print(plan) -> Optional[str]:
             if s is None:
                 return None
             args.append(s)
-        joined = "".join(f"{a}, " for a in args)
-        return f"{plan.function}({joined}{inner})"
+        # the parser puts scalars BEFORE the vector only for the
+        # histogram_quantile family; clamp/round take them after
+        if plan.function in ("histogram_quantile", "histogram_bucket",
+                             "histogram_max_quantile"):
+            joined = "".join(f"{a}, " for a in args)
+            return f"{plan.function}({joined}{inner})"
+        joined = "".join(f", {a}" for a in args)
+        return f"{plan.function}({inner}{joined})"
     if isinstance(plan, lp.ApplyMiscellaneousFunction):
         inner = _print(plan.inner)
         if inner is None:

@@ -26,6 +26,11 @@ T0 = 1_600_000_000
     "(cpu) > bool (2)",
     'label_replace(cpu, "dst", "$1", "src", "(.*)")',
     "quantile_over_time(0.5, cpu[10m])",
+    "predict_linear(disk_free[1h], 3600)",
+    "holt_winters(cpu[30m], 0.5, 0.1)",
+    "clamp(cpu, 0, 1)",
+    "clamp_min(cpu, 0)",
+    "round(cpu, 2)",
 ])
 def test_plan_to_promql_roundtrip(q):
     tsp = TimeStepParams(T0, 60, T0 + 600)

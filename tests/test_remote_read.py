@@ -89,7 +89,8 @@ def test_remote_read_endpoint():
         series = results[0]
         assert len(series) == 2                 # two instances
         for labels, samples in series:
-            assert labels["_metric_"] == "heap_usage"
+            assert labels["__name__"] == "heap_usage"
+            assert "_metric_" not in labels
             assert len(samples) == 30
             ts = [t for t, _ in samples]
             assert ts == sorted(ts)
