@@ -8,10 +8,13 @@ resident as aligned device tiles; the query grid covers the whole span
 measured per-chip throughput implies for that target.
 
 Path measured: the production tilestore fast path —
-`tilestore.evaluate_counters_t` (slot-major [N,S] tiles: each step's
-boundary reads are contiguous rows; exact f64 numerics, parity-pinned by
-tests/test_tilestore.py) + group-contiguous reshape-sum aggregation in
-f64 (exact; the planner orders series by group id host-side).
+`tilestore._eval_counter_fast` (slot-major [N,S] tiles: each step's
+boundary reads are contiguous rows; int32 relative timestamps + exact
+f64 boundary deltas + f32 extrapolation epilogue — TPU v5e has no f64
+ALU, so the all-f64 evaluator was compute-bound on float-float
+emulation; parity vs the f64 oracle is pinned at ~1e-6 relative by
+tests/test_tilestore.py) + group-contiguous reshape-sum aggregation
+(f32 partials; the planner orders series by group id host-side).
 
 Honesty notes:
 - Data is generated ON DEVICE (the axon tunnel moves ~27 MB/s; shipping
@@ -82,12 +85,13 @@ def main():
     del ts, vals
     # warm the transposed channels (tile-store pack time, excluded like
     # the reference's warm store); drop the row-major intermediates so
-    # only the two [N, S] query tiles stay resident (~3 GB)
-    arrs = tst._tiles_arrays_t(tiles, "rate")
+    # only the (int32 ts, f64 value) query tiles stay resident (~2.2 GB)
+    arrs = tst._tiles_arrays_fast(tiles, "rate")
     for a in arrs.values():
         a.block_until_ready()
     tiles._channels.clear()
     tiles._ps.clear()
+    tiles._tch.pop("ts_nan", None)
     tiles.ts = tiles.vals = tiles.valid = None
     consts = tuple(jnp.asarray(np.int64(v))
                    for v in (tiles.num_slots, tiles.base_ms, tiles.dt_ms))
@@ -97,21 +101,21 @@ def main():
 
     @jax.jit
     def many(arrs, w0s, w0e, step):
-        acc = jnp.zeros((N_GROUPS, T))
+        acc = jnp.zeros((N_GROUPS, T), jnp.float32)
         for k in range(K):
-            local = tst._eval_counter_t("rate", T, arrs, *consts,
-                                        w0s + k * 1000, w0e + k * 1000,
-                                        step)                   # [T, S]
+            local = tst._eval_counter_fast("rate", T, arrs, *consts,
+                                           w0s + k * 1000, w0e + k * 1000,
+                                           step)                # [T, S] f32
             ok = ~jnp.isnan(local)
-            v = jnp.where(ok, local, 0.0)
+            v = jnp.where(ok, local, jnp.float32(0.0))
             gsum = v.reshape(T, N_GROUPS, SG).sum(axis=2)       # [T, G]
             gcnt = ok.reshape(T, N_GROUPS, SG).sum(axis=2)
             acc = acc + jnp.where(gcnt > 0, gsum, 0.0).T
         return acc
 
-    noop = jax.jit(lambda x: jnp.zeros((N_GROUPS, T)) + x)
-    np.asarray(noop(jnp.float64(0)))
-    floor = min(_timed(lambda: np.asarray(noop(jnp.float64(i))))
+    noop = jax.jit(lambda x: jnp.zeros((N_GROUPS, T), jnp.float32) + x)
+    np.asarray(noop(jnp.float32(0)))
+    floor = min(_timed(lambda: np.asarray(noop(jnp.float32(i))))
                 for i in range(3))
 
     args = (jnp.asarray(np.int64(BASE + WINDOW)),
@@ -126,13 +130,14 @@ def main():
     device_sps = S * N / per_query_p50
 
     # bytes the evaluator actually reads per query on the dense path:
-    # 10 row-takes of [T, S] f64 (6 of ts, 4 of the value tile)
-    touched = 10 * T * S * 8
+    # 8 unique row-takes of [T, S] — 4 of the int32 ts tile, 4 of the
+    # f64 value tile
+    touched = T * S * (4 * 4 + 4 * 8)
     hbm_gbps = touched / per_query_p50 / 1e9
 
     # batched numpy oracle (same algorithm, vectorized, subsampled)
     S_cpu = 8_192
-    ts_h = np.asarray(arrs["ts"].T[:S_cpu])
+    ts_h = np.asarray(arrs["tsr"].T[:S_cpu]).astype(np.float64) + BASE
     vals_raw = _gen_vals_host(S_cpu)
     vals_h = vals_raw
     t0 = time.perf_counter()

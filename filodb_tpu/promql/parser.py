@@ -168,6 +168,10 @@ RANGE_FN_NAMES = {name: name for name in RANGE_FUNCTIONS} | {
 RANGE_FN_SCALAR_FIRST = {"quantile_over_time"}
 # functions with (range-vector, scalar...) order
 RANGE_FN_SCALAR_AFTER = {"predict_linear", "holt_winters"}
+# instant functions with (scalar, vector) order; all others take the
+# vector first (shared with the plan printer — planparser.py)
+INSTANT_FN_SCALAR_FIRST = ("histogram_quantile", "histogram_bucket",
+                           "histogram_max_quantile")
 
 INSTANT_FNS = {
     "abs", "ceil", "floor", "exp", "ln", "log2", "log10", "sqrt", "round",
@@ -646,8 +650,7 @@ class PlanBuilder:
             return self._range_fn_plan(ast)
         if name in INSTANT_FNS:
             # arg order: histogram_quantile(q, v); clamp(v, a, b); round(v, n)
-            if name in ("histogram_quantile", "histogram_bucket",
-                        "histogram_max_quantile"):
+            if name in INSTANT_FN_SCALAR_FIRST:
                 scalar_args = (self._const(ast.args[0]),)
                 inner = self._vec(ast.args[1])
             else:

@@ -149,8 +149,8 @@ def _print(plan) -> Optional[str]:
             args.append(s)
         # the parser puts scalars BEFORE the vector only for the
         # histogram_quantile family; clamp/round take them after
-        if plan.function in ("histogram_quantile", "histogram_bucket",
-                             "histogram_max_quantile"):
+        from filodb_tpu.promql.parser import INSTANT_FN_SCALAR_FIRST
+        if plan.function in INSTANT_FN_SCALAR_FIRST:
             joined = "".join(f"{a}, " for a in args)
             return f"{plan.function}({joined}{inner})"
         joined = "".join(f", {a}" for a in args)

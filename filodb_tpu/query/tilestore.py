@@ -233,6 +233,69 @@ class AlignedTiles:
     def t_prefix(self, name: str) -> jnp.ndarray:
         return self._t("_tps", name, self.prefix)
 
+    # -- int32 relative-time channels for the f32-hybrid fast path -------
+    # Timestamps as int32 ms relative to base_ms: exact (guarded to spans
+    # < 2^31 ms ≈ 24.8 days by the dispatcher), and boundary compares/
+    # subtractions become native int32 ops instead of software-emulated
+    # f64 — TPU v5e has no f64 ALU, so the all-f64 evaluator is compute-
+    # bound on float-float emulation, not HBM.
+
+    def t_tsr_i32(self) -> jnp.ndarray:
+        """[N, S] int32: ts - base_ms (0 at invalid slots)."""
+        c = self._tch.get("tsr_i32")
+        if c is None:
+            rel = jnp.where(self.valid, self.ts - self.base_ms, 0.0)
+            c = jnp.asarray(rel.T).astype(jnp.int32)
+            self._tch["tsr_i32"] = c
+        return c
+
+    def t_ff_tsr_i32(self) -> jnp.ndarray:
+        """Forward-filled relative ts; INT32_MIN where no valid slot <= i."""
+        if self._dense:
+            return self.t_tsr_i32()
+        c = self._tch.get("ff_tsr_i32")
+        if c is None:
+            f = self.ff("ts")
+            rel = jnp.where(jnp.isnan(f), float(_SENT_LO),
+                            f - self.base_ms)
+            c = jnp.asarray(rel.T).astype(jnp.int32)
+            self._tch["ff_tsr_i32"] = c
+        return c
+
+    def t_bf_tsr_i32(self) -> jnp.ndarray:
+        """Backward-filled relative ts; INT32_MAX where no valid slot >= i."""
+        if self._dense:
+            return self.t_tsr_i32()
+        c = self._tch.get("bf_tsr_i32")
+        if c is None:
+            f = self.bf("ts")
+            rel = jnp.where(jnp.isnan(f), float(_SENT_HI),
+                            f - self.base_ms)
+            c = jnp.asarray(rel.T).astype(jnp.int32)
+            self._tch["bf_tsr_i32"] = c
+        return c
+
+    def t_ones_i8(self) -> jnp.ndarray:
+        c = self._tch.get("ones_i8")
+        if c is None:
+            c = jnp.asarray(self.valid.T).astype(jnp.int8)
+            self._tch["ones_i8"] = c
+        return c
+
+    def t_ps_ones_i32(self) -> jnp.ndarray:
+        """[N+1, S] int32 inclusive prefix count with leading 0 row."""
+        c = self._tch.get("ps_ones_i32")
+        if c is None:
+            cs = jnp.cumsum(self.valid.astype(jnp.int32), axis=1)
+            ps = jnp.concatenate([jnp.zeros_like(cs[:, :1]), cs], axis=1)
+            c = jnp.asarray(ps.T)
+            self._tch["ps_ones_i32"] = c
+        return c
+
+
+_SENT_LO = -(2 ** 31)           # "no sample at or before this slot"
+_SENT_HI = 2 ** 31 - 1          # "no sample at or after this slot"
+
 
 def _estimate_dt_candidates(series: Sequence[RawSeries]) -> List[int]:
     """Scrape-cadence estimate robust to gaps and jitter: iteratively
@@ -605,23 +668,174 @@ def _eval_counter_t(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
     return jnp.where(has, out, jnp.nan)
 
 
+def _tiles_arrays_fast(tiles: AlignedTiles, func: str
+                       ) -> Dict[str, jnp.ndarray]:
+    """Channels for the f32-hybrid counter evaluator: int32 relative
+    timestamps + the exact f64 value tile. Dense tiles need only the two
+    (tsr, value) tiles — 12 bytes/sample in HBM."""
+    vch = "cv" if func in ("rate", "increase") else "v"
+    if tiles._dense:
+        return {"tsr": tiles.t_tsr_i32(), "ff_v": tiles.t_channel(vch)}
+    return {
+        "tsr": tiles.t_tsr_i32(),
+        "ones": tiles.t_ones_i8(),
+        "ps_ones": tiles.t_ps_ones_i32(),
+        "ff_tsr": tiles.t_ff_tsr_i32(),
+        "bf_tsr": tiles.t_bf_tsr_i32(),
+        "ff_v": tiles.t_ff(vch),
+        "bf_v": tiles.t_bf(vch),
+    }
+
+
+def _eval_counter_fast(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
+                       num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
+    """rate/increase/delta over transposed tiles → [T, S] **f32**.
+
+    The f32-hybrid path (rangefn/RateFunctions.scala:37 semantics):
+      * timestamps are int32 ms relative to the tile base — exact, and
+        every boundary compare/subtract is a native int32 op;
+      * the boundary value delta (v2 - v1) is computed in f64 from the
+        f64 value tile, so large counters (1e15 + small increments) keep
+        exact deltas — the catastrophic-cancellation failure a pure-f32
+        value channel would hit;
+      * the extrapolation epilogue (durations, averages, divisions) runs
+        in f32 — native TPU rate vs software-emulated f64.
+
+    Results match the exact-f64 evaluator to ~1e-6 relative (a few f32
+    ulps from the extrapolation factor). The dispatcher guards that the
+    query grid fits int32 ms relative to base; wider grids take the
+    exact path."""
+    N = num_slots
+    dense = "ps_ones" not in arrs
+    t = jnp.arange(nsteps, dtype=jnp.int64)
+    wend = w0e + t * step
+    wstart = w0s + t * step
+    k_hi = jnp.floor((wend - base + dt / 2.0) / dt).astype(jnp.int64)
+    k_lo = jnp.ceil((wstart - base - dt / 2.0) / dt).astype(jnp.int64)
+    wend_r = (wend - base).astype(jnp.int32)[:, None]       # guarded i32
+    wstart_r = (wstart - base).astype(jnp.int32)[:, None]
+    TK = lambda a, k: jnp.take(a, k, axis=0)                # [T, S] rows
+
+    kc = jnp.clip(k_hi, 0, N - 1).astype(jnp.int32)         # == khx
+    kp = jnp.clip(k_hi - 1, 0, N - 1).astype(jnp.int32)
+    kcl = jnp.clip(k_lo, 0, N - 1).astype(jnp.int32)        # == klx
+    kn = jnp.clip(k_lo + 1, 0, N - 1).astype(jnp.int32)
+
+    # the 8 unique row-takes (4 of int32 ts, 4 of f64 values); every
+    # boundary select and jitter correction below reuses these
+    ts_kc, ts_kp = TK(arrs["tsr"] if dense else arrs["ff_tsr"], kc), None
+    if dense:
+        ts_kp = TK(arrs["tsr"], kp)
+        tsb_kcl = TK(arrs["tsr"], kcl)
+        tsb_kn = TK(arrs["tsr"], kn)
+        raw_kc, raw_kcl = ts_kc, tsb_kcl
+    else:
+        ts_kp = TK(arrs["ff_tsr"], kp)
+        tsb_kcl = TK(arrs["bf_tsr"], kcl)
+        tsb_kn = TK(arrs["bf_tsr"], kn)
+        raw_kc = TK(arrs["tsr"], kc)
+        raw_kcl = TK(arrs["tsr"], kcl)
+    v_kc = TK(arrs["ff_v"], kc)
+    v_kp = TK(arrs["ff_v"], kp)
+    bf_v = arrs["ff_v"] if dense else arrs["bf_v"]
+    v_kcl = TK(bf_v, kcl)
+    v_kn = TK(bf_v, kn)
+
+    # counts: slot arithmetic (dense) / prefix diff, minus edge-slot
+    # samples that jitter outside the window
+    hi_i = (jnp.clip(k_hi, -1, N - 1) + 1).astype(jnp.int32)
+    lo_i = jnp.clip(k_lo, 0, N).astype(jnp.int32)
+    k_hi_ok = ((k_hi >= 0) & (k_hi <= N - 1))[:, None]
+    k_lo_ok = ((k_lo >= 0) & (k_lo <= N - 1))[:, None]
+    if dense:
+        counts = (hi_i - lo_i)[:, None]
+        over = k_hi_ok & (raw_kc > wend_r)
+        under = k_lo_ok & (raw_kcl < wstart_r)
+    else:
+        counts = TK(arrs["ps_ones"], hi_i) - TK(arrs["ps_ones"], lo_i)
+        ones_kc = TK(arrs["ones"], kc) > 0
+        ones_kcl = TK(arrs["ones"], kcl) > 0
+        over = k_hi_ok & ones_kc & (raw_kc > wend_r)
+        under = k_lo_ok & ones_kcl & (raw_kcl < wstart_r)
+    counts = counts - over.astype(jnp.int32) - under.astype(jnp.int32)
+
+    # last sample <= wend (2-candidate select; sentinel/NaN-filled
+    # boundaries propagate through the f64 value channel)
+    none_hi = (k_hi < 0)[:, None]
+    use1 = ts_kc <= wend_r
+    t2 = jnp.where(use1, ts_kc, ts_kp)
+    v2 = jnp.where(none_hi, jnp.nan, jnp.where(use1, v_kc, v_kp))
+    # first sample >= wstart
+    none_lo = (k_lo > N - 1)[:, None]
+    useb = tsb_kcl >= wstart_r
+    t1 = jnp.where(useb, tsb_kcl, tsb_kn)
+    v1 = jnp.where(none_lo, jnp.nan, jnp.where(useb, v_kcl, v_kn))
+
+    return _f32_epilogue(func, counts, t1, v1, t2, v2, wstart_r, wend_r,
+                         (w0e - w0s).astype(jnp.float32) / 1000.0)
+
+
+def _f32_epilogue(func, counts, t1, v1, t2, v2, wstart_r, wend_r, wdur_s):
+    """Shared f32 extrapolation epilogue: exact f64 delta, f32 factor."""
+    f32 = jnp.float32
+    delta = (v2 - v1).astype(f32)                   # exact f64 difference
+    sampled = (t2 - t1).astype(f32) / 1000.0        # exact i32 difference
+    dstart = (t1 - wstart_r).astype(f32) / 1000.0
+    dend = (wend_r - t2).astype(f32) / 1000.0
+    counts_f = counts.astype(f32)
+    avg_dur = sampled / (counts_f - 1.0)
+    if func != "delta":                             # counter zero-clamp
+        v1f = v1.astype(f32)
+        dzero = jnp.where((delta > 0) & (v1f >= 0),
+                          sampled * (v1f / jnp.where(delta == 0, jnp.nan,
+                                                     delta)),
+                          jnp.inf)
+        dstart = jnp.minimum(dstart, dzero)
+    thresh = avg_dur * 1.1
+    extrap = sampled \
+        + jnp.where(dstart < thresh, dstart, avg_dur * 0.5) \
+        + jnp.where(dend < thresh, dend, avg_dur * 0.5)
+    factor = extrap / sampled
+    if func == "rate":
+        factor = factor / wdur_s
+    out = delta * factor
+    return jnp.where(counts >= 2, out, jnp.nan)
+
+
 _EVAL_T_JIT: Dict[Tuple, object] = {}
 
 
 def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
                         window_ms: int, offset_ms: int = 0) -> jnp.ndarray:
-    """rate/increase/delta on the transposed fast path → [T, S] f64."""
+    """rate/increase/delta on the transposed fast path → [T, S].
+
+    Dispatch: the f32-hybrid evaluator (f32 output) when the query grid
+    and tile span fit int32 ms relative to the tile base (~24.8 days);
+    the exact all-f64 evaluator (f64 output) otherwise."""
     assert func in ("rate", "increase", "delta")
     nsteps = steps.size
     w0e = np.int64(steps[0] - offset_ms)
     w0s = np.int64(w0e - window_ms)
     step = np.int64(steps[1] - steps[0]) if nsteps > 1 else np.int64(1)
-    arrs = _tiles_arrays_t(tiles, func)
-    key = ("t", func, nsteps)
-    fn = _EVAL_T_JIT.get(key)
-    if fn is None:
-        fn = jax.jit(_functools.partial(_eval_counter_t, func, nsteps))
-        _EVAL_T_JIT[key] = fn
+    lo_rel = int(w0s) - tiles.base_ms
+    hi_rel = int(steps[-1] - offset_ms) - tiles.base_ms
+    fits_i32 = (_SENT_LO < lo_rel and hi_rel < _SENT_HI
+                and tiles.num_slots * tiles.dt_ms + tiles.dt_ms < _SENT_HI)
+    if fits_i32:
+        arrs = _tiles_arrays_fast(tiles, func)
+        key = ("fast", func, nsteps)
+        fn = _EVAL_T_JIT.get(key)
+        if fn is None:
+            fn = jax.jit(_functools.partial(_eval_counter_fast, func,
+                                            nsteps))
+            _EVAL_T_JIT[key] = fn
+    else:
+        arrs = _tiles_arrays_t(tiles, func)
+        key = ("t", func, nsteps)
+        fn = _EVAL_T_JIT.get(key)
+        if fn is None:
+            fn = jax.jit(_functools.partial(_eval_counter_t, func, nsteps))
+            _EVAL_T_JIT[key] = fn
     return fn(arrs, jnp.asarray(np.int64(tiles.num_slots)),
               jnp.asarray(np.int64(tiles.base_ms)),
               jnp.asarray(np.int64(tiles.dt_ms)),

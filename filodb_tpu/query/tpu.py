@@ -541,9 +541,11 @@ class TpuBackend:
         if t_dev == 0:
             return None     # every window touches live data
         if func in ("rate", "increase", "delta"):
-            # counter family rides the slot-major fast path (contiguous
-            # boundary reads; identical f64 numerics — test_tilestore
-            # pins bit-parity with evaluate_aligned)
+            # counter family rides the slot-major f32-hybrid fast path:
+            # int32 timestamps + exact f64 boundary deltas, f32
+            # extrapolation epilogue (~3e-7 relative vs the f64 oracle;
+            # grids wider than int32 ms take the exact path) —
+            # test_tilestore pins parity + the exact fallback
             out = tst.evaluate_counters_t(tiles, func, steps[:t_dev],
                                           window_ms, offset_ms).T
         else:

@@ -76,7 +76,9 @@ def test_offset_parity():
                               offset_ms=600_000)
     got = backend.periodic_samples(series, PARAMS, "rate", WINDOW, (),
                                    offset_ms=600_000)
-    np.testing.assert_allclose(got.values, oracle.values, rtol=1e-9,
+    # rate rides the tilestore f32-hybrid path (exact delta, f32
+    # extrapolation factor): ~3e-7 relative vs the f64 oracle
+    np.testing.assert_allclose(got.values, oracle.values, rtol=1e-5,
                                equal_nan=True)
 
 
@@ -113,8 +115,9 @@ def test_engine_with_tpu_backend_e2e():
                              TimeStepParams(t0 + 600, 60, t0 + 3000))
     oracle_res = QueryEngine([shard]).execute(plan)
     tpu_res = QueryEngine([shard], backend=TpuBackend()).execute(plan)
-    np.testing.assert_allclose(tpu_res.values, oracle_res.values, rtol=1e-9,
+    # rate rides the tilestore f32-hybrid path: ~3e-7 relative vs oracle
+    np.testing.assert_allclose(tpu_res.values, oracle_res.values, rtol=1e-5,
                                equal_nan=True)
     # steady increase of 7*(s+1) per 10s across 6 series
     expected = sum(0.7 * (s + 1) for s in range(6))
-    np.testing.assert_allclose(tpu_res.values[0], expected, rtol=1e-9)
+    np.testing.assert_allclose(tpu_res.values[0], expected, rtol=1e-5)
