@@ -62,7 +62,7 @@ def test_partition_routing_forwards_whole_query(two_clusters):
     for i, step in enumerate(want.steps // 1000):
         if np.isfinite(want.values[0][i]):
             np.testing.assert_allclose(got_vals[int(step)],
-                                       want.values[0][i], rtol=1e-9)
+                                       want.values[0][i], rtol=1e-5)
 
 
 def test_local_partition_stays_local(two_clusters):
