@@ -7,14 +7,18 @@ resident as aligned device tiles; the query grid covers the whole span
 (10M series x 24h on v5e-8); the printed extrapolation states what the
 measured per-chip throughput implies for that target.
 
-Path measured: the production tilestore fast path —
-`tilestore._eval_counter_fast` (slot-major [N,S] tiles: each step's
-boundary reads are contiguous rows; int32 relative timestamps + exact
-f64 boundary deltas + f32 extrapolation epilogue — TPU v5e has no f64
-ALU, so the all-f64 evaluator was compute-bound on float-float
-emulation; parity vs the f64 oracle is pinned at ~1e-6 relative by
-tests/test_tilestore.py) + group-contiguous reshape-sum aggregation
-(f32 partials; the planner orders series by group id host-side).
+Path measured: the production fused Pallas group-sum kernel
+(`pallas_kernels.counter_groupsum`, dispatched by
+`tilestore.groupsum_counters`): the whole `sum by` of `rate` runs as
+ONE pass — per step-tile, the 4 boundary row-families are DMA'd
+HBM->VMEM as contiguous blocks of the s-tile-major stride-permuted
+channels (double-buffered), the f32 extrapolation epilogue runs in
+VMEM on int32 relative timestamps + exact 3xf32-split boundary deltas,
+and group sums/counts leave the chip as [T, G] only. Parity vs the f64
+oracle is pinned at 1e-5 relative by tests/test_tilestore.py (XLA
+formulations of the same computation measured 5.5-12ms/query: row
+gathers run at ~140 GB/s, and the [T, S] rate intermediate + its
+grouping consumers cost an extra materialization pass).
 
 Honesty notes:
 - Data is generated ON DEVICE (the axon tunnel moves ~27 MB/s; shipping
@@ -22,8 +26,9 @@ Honesty notes:
   excluded (warm store, like the reference's QueryInMemoryBenchmark
   which also measures a warm in-memory store).
 - K queries with shifted step grids are chained in one program and the
-  empirical host-sync floor is subtracted, because one tunnel roundtrip
-  (~0.1s) would otherwise dominate a ~10ms query.
+  empirical host-sync floor — re-sampled right before every rep, since
+  tunnel latency drifts tens of ms — is subtracted, because one tunnel
+  roundtrip (~0.1s) would otherwise dominate a ~5ms query.
 - `vs_baseline` divides by a BATCHED numpy oracle (the same aligned
   prefix-sum/boundary algorithm vectorized over a 8,192-series
   subsample, no per-series Python loop), not an interpreter-bound loop.
@@ -48,7 +53,7 @@ DT = 10_000
 WINDOW = 300_000
 STEP = 60_000
 N_GROUPS = 16
-K = 8               # chained shifted-grid queries
+K = 16              # chained shifted-grid queries
 BASE = 1_600_000_000_000
 
 
@@ -79,65 +84,80 @@ def _gen_device():
 def main():
     from filodb_tpu.query import tilestore as tst
 
+    from filodb_tpu.query import pallas_kernels as pk
+
     ts, vals = _gen_device()
     tiles = tst.AlignedTiles([{} for _ in range(S)], BASE, DT,
                              np.ones((S, N), bool), ts, vals)
     del ts, vals
-    # warm the transposed channels (tile-store pack time, excluded like
-    # the reference's warm store); drop the row-major intermediates so
-    # only the (int32 ts, f64 value) query tiles stay resident (~2.2 GB)
-    arrs = tst._tiles_arrays_fast(tiles, "rate")
-    for a in arrs.values():
-        a.block_until_ready()
+    # warm the kernel's s-tile-major stride-permuted channels (tile-store
+    # pack time, excluded like the reference's warm store), staged so
+    # intermediates free before the next build step (the full chain would
+    # transiently exceed HBM at this shape)
+    ST = STEP // DT
+    cv_t = tiles.t_channel("cv")
+    cv_t.block_until_ready()
     tiles._channels.clear()
-    tiles._ps.clear()
-    tiles._tch.pop("ts_nan", None)
-    tiles.ts = tiles.vals = tiles.valid = None
-    consts = tuple(jnp.asarray(np.int64(v))
-                   for v in (tiles.num_slots, tiles.base_ms, tiles.dt_ms))
+    tiles.vals = None                       # cv is cached transposed
+    v_p = tiles.t_perm_split_tiled("cv", ST)   # needs ts/valid (ts plane)
+    v_p.block_until_ready()
+    del cv_t
+    tiles.ts = tiles.valid = None
+    tiles._tch.clear()
+    tiles._tperm.clear()
 
     T = (N * DT - WINDOW) // STEP           # grid covers the whole span
     SG = S // N_GROUPS                      # group-contiguous series
+    onehot = jnp.zeros((S, N_GROUPS), jnp.float32).at[
+        jnp.arange(S), jnp.arange(S) // SG].set(1.0)
+    w0e0 = BASE + WINDOW
 
     @jax.jit
-    def many(arrs, w0s, w0e, step):
-        acc = jnp.zeros((N_GROUPS, T), jnp.float32)
+    def many(shift, v_p, oh):
+        acc = jnp.zeros((T, N_GROUPS), jnp.float32)
         for k in range(K):
-            local = tst._eval_counter_fast("rate", T, arrs, *consts,
-                                           w0s + k * 1000, w0e + k * 1000,
-                                           step)                # [T, S] f32
-            ok = ~jnp.isnan(local)
-            v = jnp.where(ok, local, jnp.float32(0.0))
-            gsum = v.reshape(T, N_GROUPS, SG).sum(axis=2)       # [T, G]
-            gcnt = ok.reshape(T, N_GROUPS, SG).sum(axis=2)
-            acc = acc + jnp.where(gcnt > 0, gsum, 0.0).T
-        return acc
+            w0e = w0e0 + shift + k * 1000
+            w0s = w0e - WINDOW
+            kc0 = jnp.floor((w0e - BASE + DT / 2.0) / DT).astype(jnp.int32)
+            kl0 = jnp.ceil((w0s - BASE - DT / 2.0) / DT).astype(jnp.int32)
+            sums, cnts = pk.counter_groupsum(
+                "rate", ST, v_p, oh, kc0, kl0,
+                (w0e - BASE).astype(jnp.int32), WINDOW, STEP, T)
+            acc = acc + jnp.where(cnts > 0, sums, 0.0)
+        return acc.T
 
     noop = jax.jit(lambda x: jnp.zeros((N_GROUPS, T), jnp.float32) + x)
     np.asarray(noop(jnp.float32(0)))
-    floor = min(_timed(lambda: np.asarray(noop(jnp.float32(i))))
-                for i in range(3))
 
-    args = (jnp.asarray(np.int64(BASE + WINDOW)),
-            jnp.asarray(np.int64(BASE + WINDOW)),
-            jnp.asarray(np.int64(STEP)))
-    np.asarray(many(arrs, *args))           # compile
+    np.asarray(many(jnp.int64(0), v_p, onehot))   # compile
     runs = []
-    for _ in range(5):
-        t = _timed(lambda: np.asarray(many(arrs, *args)))
+    for i in range(7):
+        # the tunnel's host-sync floor drifts tens of ms between reps;
+        # sample it fresh right before each measurement
+        floor = min(_timed(lambda: np.asarray(noop(jnp.float32(j))))
+                    for j in range(2))
+        t = _timed(lambda: np.asarray(
+            many(jnp.int64(i * 1000), v_p, onehot)))
         runs.append(max(t - min(floor, t * 0.5), t * 0.05) / K)
     per_query_p50 = float(np.median(runs))
     device_sps = S * N / per_query_p50
 
-    # bytes the evaluator actually reads per query on the dense path:
-    # 8 unique row-takes of [T, S] — 4 of the int32 ts tile, 4 of the
-    # f64 value tile
-    touched = T * S * (4 * 4 + 4 * 8)
+    # bytes the kernel actually reads per query: 4 boundary families x
+    # (i32 ts + packed 3xf32 values), each DMA block carrying the
+    # (TT+AL)/TT sublane-alignment overhead
+    touched = int(T * S * 4 * (4 + 12)
+                  * (pk._GS_TT + pk._GS_AL) / pk._GS_TT)
     hbm_gbps = touched / per_query_p50 / 1e9
 
     # batched numpy oracle (same algorithm, vectorized, subsampled)
     S_cpu = 8_192
-    ts_h = np.asarray(arrs["tsr"].T[:S_cpu]).astype(np.float64) + BASE
+    # un-permute the ts plane (bitcast f32 lanes 0:SS) of the packed
+    # tile: [n_s, st, G, 4SS] with slot k of series (si*SS + j) at
+    # [si, k % st, k // st, j]
+    n_keep = S_cpu // pk._GS_SS
+    perm_h = np.asarray(v_p[:n_keep, :, :, :pk._GS_SS])
+    ts_h = perm_h.transpose(0, 3, 2, 1).reshape(
+        S_cpu, -1)[:, :N].astype(np.float64) + BASE
     vals_raw = _gen_vals_host(S_cpu)
     vals_h = vals_raw
     t0 = time.perf_counter()

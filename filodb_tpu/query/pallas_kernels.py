@@ -60,6 +60,229 @@ def combine3(c: jnp.ndarray) -> jnp.ndarray:
             + c[..., 2, :].astype(jnp.float64))
 
 
+# ---------------------------------------------------------------------------
+# Fused counter group-sum kernel: the north-star `sum by (g) (rate(c[w]))`
+# as ONE pass over the stride-permuted tiles. XLA's best arrangement of
+# the same computation (slices -> epilogue -> one-hot matmul) pays ~2.5x
+# the HBM traffic materializing the [T, S] rate intermediate and
+# re-reading it on the MXU; here the 4 boundary row-blocks per step-tile
+# are DMA'd HBM->VMEM (double-buffered), the f32 extrapolation epilogue
+# (rangefn/RateFunctions.scala:23-79 semantics) runs in VMEM, and only
+# the [T, G] group sums + counts ever leave the chip. Values ride the
+# exact 3xf32 split (53 <= 24*3 mantissa bits), so boundary deltas keep
+# f64 precision without f64 ALU ops.
+# ---------------------------------------------------------------------------
+
+_GS_TT = 128           # query steps per tile (sublane dim of compute)
+_GS_SS = 512           # series per tile (lane dim)
+_GS_AL = 8             # sublane alignment Mosaic requires of HBM slices
+
+
+def _groupsum_kernel(func: str, st: int, n_ttiles: int,
+                     params_ref, v_ref, oh_ref,
+                     sum_ref, cnt_ref, v_scr, sems):
+    """Grid: (n_s,). params (SMEM, i32):
+    [kc0, kp0, kl0, kn0, w0e_rel, window, step, counts_base, T].
+    """
+    si = pl.program_id(0)
+    kstarts = [params_ref[0], params_ref[1], params_ref[2], params_ref[3]]
+    w0e_rel = params_ref[4]
+    window = params_ref[5]
+    step = params_ref[6]
+    counts_base = params_ref[7]
+    T = params_ref[8]
+
+    def fam_g(f, ti):
+        """(aligned DMA start, in-block row offset) for family f, tile ti.
+        HBM slices on the tiled G dim must start at a sublane-tile
+        multiple, so the DMA reads _GS_AL extra rows and the compute
+        phase shifts by `off` inside VMEM."""
+        kf = kstarts[f]
+        g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
+        g8 = pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
+        return g8, g - g8
+
+    def dmas(slot, ti):
+        out = []
+        for f in range(4):
+            kf = kstarts[f]
+            r = jax.lax.rem(kf, jnp.int32(st))
+            # the permuted G axis is padded past every tail tile
+            # (t_perm_tiled), so the block stays in bounds; dead rows
+            # are masked out of every contribution below via `live`.
+            # ONE copy per family: timestamps (bitcast f32) + h/m/l
+            # value planes ride a single CONTIGUOUS HBM read —
+            # consecutive G rows of a (s-tile, residue) plane are
+            # adjacent in memory.
+            g8, _ = fam_g(f, ti)
+            out.append(pltpu.make_async_copy(
+                v_ref.at[si, r, pl.ds(g8, _GS_TT + _GS_AL), :],
+                v_scr.at[slot, f], sems.at[slot, f]))
+        return out
+
+    @pl.when(si == 0)
+    def _():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+    for d in dmas(0, 0):
+        d.start()
+
+    def t_loop(ti, _):
+        slot = jax.lax.rem(ti, 2)
+        nxt = jax.lax.rem(ti + 1, 2)
+
+        @pl.when(ti + 1 < n_ttiles)
+        def _():
+            for d in dmas(nxt, ti + 1):
+                d.start()
+        for d in dmas(slot, ti):
+            d.wait()
+
+        gt = ti * _GS_TT + jax.lax.broadcasted_iota(
+            jnp.int32, (_GS_TT, 1), 0)                     # [TT, 1]
+        live = gt < T
+        wend_r = w0e_rel + gt * step
+        wstart_r = wend_r - window
+        offs = [fam_g(f, ti)[1] for f in range(4)]
+
+        def shifted(full, f):
+            """Drop the first `offs[f]` alignment rows of a loaded
+            [TT+AL, SS] block -> [TT, SS] via dynamic sublane rotate
+            (plain dynamic_slice on vectors has no Mosaic lowering, and
+            NEGATIVE dynamic roll shifts mis-lower — rotate left by
+            `len - off` instead)."""
+            return pltpu.roll(full, shift=(_GS_TT + _GS_AL) - offs[f],
+                              axis=0)[:_GS_TT]
+
+        vs = [shifted(v_scr[slot, f], f) for f in range(4)]
+
+        def tsch(f):
+            return vs[f][:, :_GS_SS]
+
+        ts_kc = tsch(0)
+        ts_kp = tsch(1)
+        ts_kcl = tsch(2)
+        ts_kn = tsch(3)
+        over = ts_kc > wend_r
+        under = ts_kcl < wstart_r
+        counts = (counts_base - over.astype(jnp.int32)
+                  - under.astype(jnp.int32))
+        use1 = ~over                                       # ts_kc <= wend
+        useb = ~under
+        t2 = jnp.where(use1, ts_kc, ts_kp)
+        t1 = jnp.where(useb, ts_kcl, ts_kn)
+
+        def vch(f, c):
+            """h/m/l plane c of family f (packed after the ts plane)."""
+            return jax.lax.bitcast_convert_type(
+                vs[f][:, (c + 1) * _GS_SS:(c + 2) * _GS_SS], jnp.float32)
+
+        h2 = jnp.where(use1, vch(0, 0), vch(1, 0))
+        m2 = jnp.where(use1, vch(0, 1), vch(1, 1))
+        l2 = jnp.where(use1, vch(0, 2), vch(1, 2))
+        h1 = jnp.where(useb, vch(2, 0), vch(3, 0))
+        m1 = jnp.where(useb, vch(2, 1), vch(3, 1))
+        l1 = jnp.where(useb, vch(2, 2), vch(3, 2))
+        # exact-split delta: each per-channel difference is (near-)exact,
+        # and the sum telescopes to the f64 difference (see split3)
+        delta = (h2 - h1) + (m2 - m1) + (l2 - l1)
+        sampled = (t2 - t1).astype(jnp.float32) * 1e-3
+        dstart = (t1 - wstart_r).astype(jnp.float32) * 1e-3
+        dend = (wend_r - t2).astype(jnp.float32) * 1e-3
+        counts_f = counts.astype(jnp.float32)
+        avg = sampled / (counts_f - 1.0)
+        if func != "delta":
+            v1f = h1 + (m1 + l1)
+            dzero = jnp.where(
+                (delta > 0) & (v1f >= 0),
+                sampled * (v1f / jnp.where(delta == 0, jnp.nan, delta)),
+                jnp.inf)
+            dstart = jnp.minimum(dstart, dzero)
+        th = avg * 1.1
+        extrap = sampled \
+            + jnp.where(dstart < th, dstart, avg * 0.5) \
+            + jnp.where(dend < th, dend, avg * 0.5)
+        factor = extrap / sampled
+        if func == "rate":
+            factor = factor / (window.astype(jnp.float32) * 1e-3)
+        out = delta * factor
+        ok = live & (counts >= 2) & ~jnp.isnan(out)
+        local = jnp.where(ok, out, jnp.float32(0.0))
+        okf = jnp.where(ok, jnp.float32(1.0), jnp.float32(0.0))
+        oh = oh_ref[:]
+        sl = pl.ds(ti * _GS_TT, _GS_TT)
+        # HIGHEST: the MXU's default bf16 input truncation would round
+        # every rate to 8 mantissa bits (bf16(0.1) = 0.10009765625)
+        sum_ref[sl, :] += jnp.dot(local, oh,
+                                  preferred_element_type=jnp.float32,
+                                  precision=jax.lax.Precision.HIGHEST)
+        cnt_ref[sl, :] += jnp.dot(okf, oh,
+                                  preferred_element_type=jnp.float32,
+                                  precision=jax.lax.Precision.HIGHEST)
+
+    jax.lax.fori_loop(0, n_ttiles, t_loop, None)
+
+
+def counter_groupsum(func: str, st: int, v_p, onehot,
+                     kc0: int, kl0: int, w0e_rel: int, window: int,
+                     step: int, nsteps: int,
+                     interpret: bool = False):
+    """sum by(group) of rate/increase/delta over stride-permuted dense
+    tiles -> (sums f32 [T, G], counts f32 [T, G]; sum is only meaningful
+    where count > 0).
+
+    v_p: the packed kernel channel [n_s, st, G_perm, 4*_GS_SS] i32 —
+    plane 0 = int32 relative timestamps, planes 1-3 = the exact 3xf32
+    split BITCAST to i32 (int lanes are inert in data movement; i32
+    timestamps bitcast to f32 would be flush-to-zero denormals) of the
+    (counter-corrected) value channel
+    (AlignedTiles.t_perm_split_tiled). onehot: [n_s * _GS_SS, G] f32
+    group membership (pad series with all-zero one-hot rows).
+    Preconditions (the tilestore dispatcher checks them): regular grid
+    step == st*dt entirely interior to the tile, dense tiles, span fits
+    int32 ms."""
+    n_s = v_p.shape[0]
+    G = onehot.shape[1]
+    assert onehot.shape[0] == n_s * _GS_SS, (onehot.shape, n_s)
+    T_pad = -(-nsteps // _GS_TT) * _GS_TT
+    n_ttiles = T_pad // _GS_TT
+    params = jnp.asarray(
+        jnp.stack([jnp.asarray(v, jnp.int32) for v in (
+            kc0, kc0 - 1, kl0, kl0 + 1, w0e_rel, window, step,
+            kc0 + 1 - kl0, nsteps)]))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_s,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((_GS_SS, G), lambda si, p: (si, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((T_pad, G), lambda si, p: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T_pad, G), lambda si, p: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 4, _GS_TT + _GS_AL, 4 * _GS_SS), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+    )
+    with jax.enable_x64(False):
+        sums, cnts = pl.pallas_call(
+            functools.partial(_groupsum_kernel, func, st, n_ttiles),
+            grid_spec=grid_spec,
+            out_shape=(
+                jax.ShapeDtypeStruct((T_pad, G), jnp.float32),
+                jax.ShapeDtypeStruct((T_pad, G), jnp.float32),
+            ),
+            interpret=interpret,
+        )(params, v_p, onehot)
+    return sums[:nsteps], cnts[:nsteps]
+
+
 def _extract_kernel(nchan: int, params_ref, tr_ref, pay_ref,
                     cnt_ref, tlo_ref, thi_ref, plo_ref, phi_ref):
     """One (series-tile, window-tile) program."""

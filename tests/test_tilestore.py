@@ -328,3 +328,52 @@ def test_transposed_dense_fast_path_matches():
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-9,
                                    equal_nan=True, err_msg=func)
         assert np.array_equal(np.isnan(got), np.isnan(want)), func
+
+
+def test_slide_path_bitwise_matches_gather_fast_path():
+    """Regular in-bounds grids over dense tiles dispatch to the stride-
+    permuted slide evaluator; results must be BITWISE identical to the
+    gather fast path (same ops, different read pattern), and irregular
+    or out-of-range grids must fall back."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from filodb_tpu.query import tilestore as tst
+    rng = np.random.default_rng(23)
+    S, N, dt = 16, 288, 10_000
+    base = 1_600_000_000_000
+    ts_true = (base + np.arange(N)[None, :] * dt
+               + rng.integers(-2000, 2000, (S, N))).astype(np.float64)
+    vals = np.cumsum(rng.uniform(0, 5, (S, N)), axis=1)
+    vals[3, 100:] *= 0.1          # reset
+    tiles = tst.AlignedTiles([{} for _ in range(S)], base, dt,
+                             np.ones((S, N), bool), ts_true, vals)
+    steps = np.arange(base + 400_000, base + 2_000_000, 60_000,
+                      dtype=np.int64)
+    for func in ("rate", "increase", "delta"):
+        got = np.asarray(tst.evaluate_counters_t(tiles, func, steps,
+                                                 300_000))
+        assert (("slide", func, steps.size, 6) in tst._EVAL_T_JIT), func
+        arrs = tst._tiles_arrays_fast(tiles, func)
+        ref = np.asarray(jax.jit(functools.partial(
+            tst._eval_counter_fast, func, steps.size))(
+                arrs, jnp.asarray(np.int64(N)), jnp.asarray(np.int64(base)),
+                jnp.asarray(np.int64(dt)),
+                jnp.asarray(np.int64(steps[0] - 300_000)),
+                jnp.asarray(np.int64(steps[0])),
+                jnp.asarray(np.int64(60_000))))
+        assert got.dtype == ref.dtype == np.float32
+        np.testing.assert_array_equal(got, ref, err_msg=func)
+    # grid past the tile end and a non-multiple step both fall back
+    # (no new slide jit entries) yet still produce results
+    before = {k for k in tst._EVAL_T_JIT if k[0] == "slide"}
+    over = np.arange(base + 400_000, base + N * dt + 600_000, 60_000,
+                     dtype=np.int64)
+    r = np.asarray(tst.evaluate_counters_t(tiles, "rate", over, 300_000))
+    assert np.isfinite(r).any()
+    odd = np.arange(base + 400_000, base + 2_000_000, 61_000,
+                    dtype=np.int64)
+    np.asarray(tst.evaluate_counters_t(tiles, "rate", odd, 300_000))
+    assert {k for k in tst._EVAL_T_JIT if k[0] == "slide"} == before
