@@ -837,6 +837,12 @@ class TimeSeriesMemStore:
     def get_shard(self, ref: DatasetRef, shard_num: int) -> TimeSeriesShard:
         return self._shards[ref][shard_num]
 
+    def remove_shard(self, ref: DatasetRef, shard_num: int) -> None:
+        """Release a shard (elastic recovery hand-back: the adopter drops
+        its copy when the original owner returns — ShardManager.scala
+        stopShards semantics)."""
+        self._shards.get(ref, {}).pop(shard_num, None)
+
     def shards(self, ref: DatasetRef) -> List[TimeSeriesShard]:
         return [s for _, s in sorted(self._shards.get(ref, {}).items())]
 

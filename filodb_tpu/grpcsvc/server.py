@@ -1,0 +1,115 @@
+"""gRPC query service server (PromQLGrpcServer.scala:44).
+
+Serves two unary RPCs on `/filodb.QueryService/`:
+
+  * ``FetchRaw`` — the leaf-dispatch data plane: span-bounded raw series
+    with node-scoped snapshot keys, protobuf + NibblePack on the wire
+    (replaces the base64-JSON POST /api/v1/raw hop).
+  * ``Exec`` — whole-query pushdown / federation: evaluate a PromQL
+    string locally and return the grid as packed columns
+    (exec/PromQlRemoteExec.scala without the JSON).
+
+Implemented over grpcio's generic handlers with identity serializers —
+message codecs live in grpcsvc.wire; no protoc codegen needed."""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+from filodb_tpu.grpcsvc import wire
+
+_SERVICE = "filodb.QueryService"
+
+
+class GrpcQueryServer:
+    """Binds the service to a FiloHttpServer's query surface (the HTTP
+    server owns planners, shard maps, and guardrails; this is a second
+    wire onto the same brain)."""
+
+    def __init__(self, http_server, port: int = 0, host: str = "127.0.0.1",
+                 max_workers: int = 8):
+        import grpc
+        self.http = http_server
+        self.rpcs_served = 0
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                name = details.method.rsplit("/", 1)[-1]
+                if details.method.startswith(f"/{_SERVICE}/"):
+                    if name == "FetchRaw":
+                        return grpc.unary_unary_rpc_method_handler(
+                            outer._fetch_raw,
+                            request_deserializer=lambda b: b,
+                            response_serializer=lambda b: b)
+                    if name == "Exec":
+                        return grpc.unary_unary_rpc_method_handler(
+                            outer._exec,
+                            request_deserializer=lambda b: b,
+                            response_serializer=lambda b: b)
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> "GrpcQueryServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=1)
+
+    # -- RPC implementations ---------------------------------------------
+
+    def _fetch_raw(self, request: bytes, context) -> bytes:
+        from filodb_tpu.query.model import QueryError, QueryStats
+        self.rpcs_served += 1
+        try:
+            req = wire.decode_raw_request(request)
+            series = self.http.leaf_select(
+                req["dataset"], req["filters"], req["start_ms"],
+                req["end_ms"], req["column"], req["shards"],
+                span_snap=req["span_snap"], stats=QueryStats())
+            if series is None:
+                return wire.encode_raw_response(
+                    [], error=f"dataset {req['dataset']} not set up")
+            return wire.encode_raw_response(series)
+        except QueryError as e:
+            return wire.encode_raw_response([], error=str(e))
+        except Exception as e:           # wire errors back, never crash
+            return wire.encode_raw_response(
+                [], error=f"internal: {type(e).__name__}: {e}")
+
+    def _exec(self, request: bytes, context) -> bytes:
+        from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
+                                              parse_query_range)
+        from filodb_tpu.query.model import (GridResult, QueryError,
+                                            ScalarResult)
+        self.rpcs_served += 1
+        try:
+            req = wire.decode_exec_request(request)
+            engine = self.http.make_planner(
+                req["dataset"], local_dispatch=req["local_only"])
+            if engine is None:
+                return wire.encode_exec_response(
+                    None, error=f"dataset {req['dataset']} not set up")
+            if req["step_ms"] > 0:
+                plan = parse_query_range(
+                    req["query"],
+                    TimeStepParams(req["start_ms"] // 1000,
+                                   req["step_ms"] // 1000,
+                                   req["end_ms"] // 1000))
+            else:
+                plan = parse_query(req["query"], req["start_ms"] // 1000)
+            res = engine.execute(plan)
+            if isinstance(res, ScalarResult):
+                res = GridResult(res.steps, [{}], res.values[None, :])
+            return wire.encode_exec_response(res, stats=engine.stats)
+        except QueryError as e:
+            return wire.encode_exec_response(None, error=str(e))
+        except Exception as e:
+            return wire.encode_exec_response(
+                None, error=f"internal: {type(e).__name__}: {e}")

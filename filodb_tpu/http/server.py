@@ -56,7 +56,9 @@ class FiloHttpServer:
                  peers: Optional[Dict[str, str]] = None,
                  buddies: Optional[Dict[str, str]] = None,
                  partitions: Optional[Dict[str, str]] = None,
-                 local_partitions: Optional[List[str]] = None):
+                 local_partitions: Optional[List[str]] = None,
+                 grpc_peers: Optional[Dict[str, str]] = None,
+                 grpc_partitions: Optional[Dict[str, str]] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -73,6 +75,8 @@ class FiloHttpServer:
         self.buddies = dict(buddies or {})
         self.partitions = dict(partitions or {})
         self.local_partitions = list(local_partitions or ())
+        self.grpc_peers = dict(grpc_peers or {})
+        self.grpc_partitions = dict(grpc_partitions or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -171,28 +175,13 @@ class FiloHttpServer:
         if not m:
             return 404, prom_json.error(f"no route for {path}", "not_found")
         ds, rest = m.group("ds"), m.group("rest")
-        shards = self.shards_by_dataset.get(ds)
-        if shards is None:
-            return 400, prom_json.error(f"dataset {ds} not set up")
         # dispatch=local: a forwarded query must evaluate on this node's
         # shards only (no fan-back-out; loop prevention for pushdown —
         # federation forwarding is likewise disabled)
         local_dispatch = self._param(qs, "dispatch") == "local"
-        peers = {} if local_dispatch else self.peers
-        partitions = {} if local_dispatch else self.partitions
-        engine = QueryPlanner(shards, backend=self.backend,
-                              shard_mapper=self.shard_mapper,
-                              mesh_executor=self.mesh_executor,
-                              spread=self.spread,
-                              ds_store=self.ds_store_by_dataset.get(ds),
-                              raw_retention_ms=self.raw_retention_ms,
-                              limits=self.query_limits,
-                              spread_provider=self.spread_provider,
-                              node_id=self.node_id, peers=peers,
-                              buddies=self.buddies,
-                              partitions=partitions,
-                              local_partitions=self.local_partitions,
-                              dataset=ds)
+        engine = self.make_planner(ds, local_dispatch=local_dispatch)
+        if engine is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
         if rest == "query_range":
             return self._query_range(engine, qs)
         if rest == "query":
@@ -207,6 +196,33 @@ class FiloHttpServer:
         if rest == "read":
             return self._remote_read(ds, body_raw)
         return 404, prom_json.error(f"no route for {path}", "not_found")
+
+    def make_planner(self, ds: str, local_dispatch: bool = False):
+        """Planner over this node's view of a dataset (shared by the HTTP
+        endpoints and the gRPC query service). ``local_dispatch`` pins
+        evaluation to local shards — no peer fan-out, no federation."""
+        shards = self.shards_by_dataset.get(ds)
+        if shards is None:
+            return None
+        peers = {} if local_dispatch else self.peers
+        partitions = {} if local_dispatch else self.partitions
+        grpc_peers = {} if local_dispatch else self.grpc_peers
+        grpc_partitions = {} if local_dispatch else self.grpc_partitions
+        return QueryPlanner(shards, backend=self.backend,
+                            shard_mapper=self.shard_mapper,
+                            mesh_executor=self.mesh_executor,
+                            spread=self.spread,
+                            ds_store=self.ds_store_by_dataset.get(ds),
+                            raw_retention_ms=self.raw_retention_ms,
+                            limits=self.query_limits,
+                            spread_provider=self.spread_provider,
+                            node_id=self.node_id, peers=peers,
+                            buddies=self.buddies,
+                            partitions=partitions,
+                            local_partitions=self.local_partitions,
+                            dataset=ds,
+                            grpc_peers=grpc_peers,
+                            grpc_partitions=grpc_partitions)
 
     # -- endpoints --------------------------------------------------------
     @staticmethod
@@ -386,6 +402,9 @@ class FiloHttpServer:
                  getattr(self.backend, "tile_builds", 0))
             emit("tile_cache_hits_total", {},
                  getattr(self.backend, "tile_hits", 0))
+        gs = getattr(self, "grpc_server", None)
+        if gs is not None:
+            emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
         return "\n".join(lines) + "\n"
 
     def _cardinality(self, ds: str, qs: Dict, local: bool = False):
@@ -431,25 +450,42 @@ class FiloHttpServer:
         the entry node evaluates the plan over the merged series)."""
         from filodb_tpu.parallel.cluster import (series_to_wire,
                                                  wire_to_filters)
-        from filodb_tpu.query.engine import select_raw_series
         from filodb_tpu.query.model import QueryStats
         if body is None:
             return 400, prom_json.error("missing JSON body")
+        series = self.leaf_select(
+            ds, wire_to_filters(body.get("filters", [])),
+            int(body["start_ms"]), int(body["end_ms"]),
+            body.get("column"), body.get("shards"),
+            span_snap=bool(body.get("full", True)), stats=QueryStats())
+        if series is None:
+            return 400, prom_json.error(f"dataset {ds} not set up")
+        return 200, {"status": "success", "data": series_to_wire(series)}
+
+    def leaf_select(self, ds: str, filters, start_ms: int, end_ms: int,
+                    column, want_shards, span_snap: bool = True,
+                    stats=None):
+        """Shared leaf-dispatch selection (HTTP raw endpoint + the gRPC
+        FetchRaw service): span-bounded reads with node-scoped snapshot
+        keys, so the payload scales with the query span, not retention
+        (SerializedRangeVector semantics, RangeVector.scala:452)."""
+        from filodb_tpu.query.engine import (select_raw_series,
+                                             select_span_series)
         shards = self.shards_by_dataset.get(ds)
         if shards is None:
-            return 400, prom_json.error(f"dataset {ds} not set up")
+            return None
         by_num = {getattr(s, "shard_num", i): s
                   for i, s in enumerate(shards)}
-        want = body.get("shards")
-        subset = [by_num[n] for n in want if n in by_num] \
-            if want is not None else shards
-        series = select_raw_series(
-            subset, wire_to_filters(body.get("filters", [])),
-            int(body["start_ms"]), int(body["end_ms"]),
-            body.get("column"), QueryStats(),
-            full=bool(body.get("full", True)),
-            limits=self.query_limits)
-        return 200, {"status": "success", "data": series_to_wire(series)}
+        subset = [by_num[n] for n in want_shards if n in by_num] \
+            if want_shards is not None else shards
+        if span_snap:
+            return select_span_series(
+                subset, filters, start_ms, end_ms, column, stats,
+                limits=self.query_limits, node_id=self.node_id or "",
+                ds=ds)
+        return select_raw_series(
+            subset, filters, start_ms, end_ms, column, stats,
+            full=False, limits=self.query_limits)
 
     def _live_peer_urls(self, path_fmt: str, qs: Dict) -> List[str]:
         """URLs for peers whose shards are still queryable (dead peers are

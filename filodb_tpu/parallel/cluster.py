@@ -59,6 +59,9 @@ def series_to_wire(series: Sequence[RawSeries]) -> List[Dict]:
         if s.hist_drop_rows is not None:
             d["drops"] = _b64(np.asarray(s.hist_drop_rows,
                                          dtype=np.int64))
+        if s.snapshot_key is not None:
+            d["snap"] = list(s.snapshot_key)
+            d["chunk_len"] = int(s.chunk_len)
         out.append(d)
     return out
 
@@ -78,6 +81,8 @@ def wire_to_series(rows: Sequence[Dict]) -> List[RawSeries]:
             is_counter=d["is_counter"],
             bucket_les=les,
             hist_drop_rows=drops,
+            snapshot_key=tuple(d["snap"]) if "snap" in d else None,
+            chunk_len=int(d.get("chunk_len", -1)),
         ))
     return out
 
@@ -235,15 +240,34 @@ class PromQlRemoteExec:
                 f"query={self.query!r})")
 
 
+def reassign_dead_shards(dead_shards: Sequence[int],
+                         survivors: Sequence[str]) -> Dict[int, str]:
+    """Deterministic round-robin of a dead node's shards over the sorted
+    survivor set (ShardAssignmentStrategy.scala:188 — every node computes
+    the same table independently, no coordinator election needed)."""
+    ordered = sorted(survivors)
+    return {sh: ordered[i % len(ordered)]
+            for i, sh in enumerate(sorted(dead_shards))}
+
+
 class FailureDetector:
     """Health-poll peers; flip their shards DOWN after consecutive misses
     and back ACTIVE on recovery (the Akka-cluster gossip/DeathWatch +
-    ShardManager reaction, ShardManager.scala:28, without reassignment)."""
+    ShardManager reaction, ShardManager.scala:28).
+
+    With ``reassign_grace_s`` set, a node held DOWN past the grace window
+    triggers ``on_node_down(node)`` exactly once — the server's elastic
+    recovery hook (ShardManager.scala:28 assignShardsToNodes +
+    ShardAssignmentStrategy.scala:188): survivors adopt the dead node's
+    shards deterministically. When the node comes back, ``on_node_up``
+    runs instead of the plain ACTIVE flip so adopters can release."""
 
     def __init__(self, mapper: ShardMapper, peers: Dict[str, str],
                  shards_by_node: Dict[str, Sequence[int]],
                  interval_s: float = 0.5, threshold: int = 3,
-                 timeout_s: float = 2.0):
+                 timeout_s: float = 2.0,
+                 reassign_grace_s: Optional[float] = None,
+                 on_node_down=None, on_node_up=None):
         self.mapper = mapper
         self.peers = dict(peers)
         self.shards_by_node = {k: list(v) for k, v in
@@ -251,8 +275,13 @@ class FailureDetector:
         self.interval_s = interval_s
         self.threshold = threshold
         self.timeout_s = timeout_s
+        self.reassign_grace_s = reassign_grace_s
+        self.on_node_down = on_node_down
+        self.on_node_up = on_node_up
         self._misses: Dict[str, int] = {p: 0 for p in peers}
         self._down: Dict[str, bool] = {p: False for p in peers}
+        self._down_since: Dict[str, float] = {}
+        self._reassigned: Dict[str, bool] = {p: False for p in peers}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -264,12 +293,32 @@ class FailureDetector:
         except OSError:
             return False
 
+    def is_down(self, node: str) -> bool:
+        return self._down.get(node, False)
+
+    def alive_peers(self) -> List[str]:
+        return [p for p in self.peers if not self._down.get(p, False)]
+
     def poll_once(self) -> None:
         for node, url in self.peers.items():
             if self._alive(url):
                 self._misses[node] = 0
                 if self._down[node]:
                     self._down[node] = False
+                    self._down_since.pop(node, None)
+                    if self._reassigned.get(node, False):
+                        self._reassigned[node] = False
+                        if self.on_node_up is not None:
+                            try:
+                                self.on_node_up(node)
+                            except Exception:
+                                # a failing hook must not kill the
+                                # monitoring thread
+                                pass
+                            continue
+                        # no release hook: fall through to the plain
+                        # ACTIVE flip so the recovered node's shards
+                        # don't stay reassigned forever
                     for sh in self.shards_by_node.get(node, []):
                         self.mapper.update(sh, ShardStatus.ACTIVE, node)
             else:
@@ -277,8 +326,19 @@ class FailureDetector:
                 if self._misses[node] >= self.threshold \
                         and not self._down[node]:
                     self._down[node] = True
+                    self._down_since[node] = time.monotonic()
                     for sh in self.shards_by_node.get(node, []):
                         self.mapper.update(sh, ShardStatus.DOWN, node)
+                if (self._down[node] and self.reassign_grace_s is not None
+                        and not self._reassigned.get(node, False)
+                        and time.monotonic() - self._down_since[node]
+                        >= self.reassign_grace_s):
+                    self._reassigned[node] = True
+                    if self.on_node_down is not None:
+                        try:
+                            self.on_node_down(node)
+                        except Exception:
+                            pass     # keep the monitor thread alive
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):

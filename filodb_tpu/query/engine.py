@@ -122,6 +122,66 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     return out
 
 
+def select_span_series(shards: Sequence[TimeSeriesShard],
+                       filters: Sequence[ColumnFilter],
+                       start_ms: int, end_ms: int,
+                       column: Optional[str] = None,
+                       stats: Optional[QueryStats] = None,
+                       limits: Optional[QueryLimits] = None,
+                       node_id: str = "", ds: str = "") -> List[RawSeries]:
+    """Leaf-dispatch selection: SPAN-BOUNDED reads with node-scoped
+    snapshot keys — the SerializedRangeVector analogue
+    (core/query/RangeVector.scala:452). The wire payload scales with the
+    query span (lookback is already folded into ``start_ms`` by the
+    planner), never with retention. Each series carries
+    ``snapshot_key = (node, ds, shard, part, num_chunks, col, span)`` and
+    ``chunk_len`` = its immutable in-span prefix, so the entry node's
+    device tile cache reuses tiles across identical re-fetches while
+    write-buffer tail rows are spliced live."""
+    out: List[RawSeries] = []
+    for shard in shards:
+        for part in shard.lookup_partitions(filters, start_ms, end_ms):
+            schema = part.schema
+            col_name = column or schema.value_column
+            try:
+                ci = [c.name for c in schema.columns].index(col_name)
+            except ValueError:
+                raise QueryError(
+                    f"schema {schema.name} has no column {col_name}")
+            col = schema.columns[ci]
+            ts_all, val_all, full_chunk_len = part.read_full(ci)
+            lo = int(np.searchsorted(ts_all, start_ms, side="left"))
+            hi = int(np.searchsorted(ts_all, end_ms, side="right"))
+            ts, vals = ts_all[lo:hi], val_all[lo:hi]
+            chunk_len = int(np.clip(full_chunk_len - lo, 0, hi - lo))
+            snap = (node_id, ds, shard.shard_num, part.part_id,
+                    part.num_chunks, ci, int(start_ms), int(end_ms))
+            les = None
+            drops = None
+            if col.col_type == ColumnType.HISTOGRAM:
+                les = part._hist_scheme.les() \
+                    if part._hist_scheme is not None else None
+                if col.is_counter_like:
+                    d = part.hist_drop_rows(ci)
+                    d = d[(d >= lo) & (d < hi)] - lo
+                    drops = d
+            out.append(RawSeries(
+                labels=dict(part.part_key.labels),
+                ts=ts, values=vals,
+                is_counter=col.is_counter_like,
+                bucket_les=les,
+                snapshot_key=snap,
+                chunk_len=chunk_len,
+                hist_drop_rows=drops,
+            ))
+            if stats is not None:
+                stats.series_scanned += 1
+                stats.samples_scanned += int(ts.size)
+                if limits is not None:
+                    limits.check(stats)
+    return out
+
+
 def clip_series(series: Sequence[RawSeries], start_ms: int, end_ms: int
                 ) -> List[RawSeries]:
     """Restrict each series to samples in [start_ms, end_ms] (views, no

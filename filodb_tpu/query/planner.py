@@ -457,7 +457,9 @@ class QueryPlanner:
                  buddies: Optional[Dict[str, str]] = None,
                  partitions: Optional[Dict[str, str]] = None,
                  local_partitions: Optional[Sequence[str]] = None,
-                 dataset: str = "timeseries"):
+                 dataset: str = "timeseries",
+                 grpc_peers: Optional[Dict[str, str]] = None,
+                 grpc_partitions: Optional[Dict[str, str]] = None):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -493,6 +495,12 @@ class QueryPlanner:
         # workspaces THIS cluster serves; never forwarded (self-loop guard)
         self.local_partitions = frozenset(local_partitions or ())
         self.dataset = dataset
+        # binary data plane: node/workspace -> grpc host:port; when a peer
+        # advertises one, leaf dispatch and pushdown ride protobuf +
+        # NibblePack over a persistent channel instead of base64-JSON
+        # (grpcsvc; PromQLGrpcServer.scala:44)
+        self.grpc_peers = dict(grpc_peers or {})
+        self.grpc_partitions = dict(grpc_partitions or {})
         self.stats = QueryStats()
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
@@ -592,8 +600,14 @@ class QueryPlanner:
                 continue
             by_node.setdefault(node, []).append(n)
         for node, group in sorted(by_node.items()):
-            local.append(RemoteShardGroup(node, self.peers[node],
-                                          self.dataset, group))
+            gaddr = self.grpc_peers.get(node)
+            if gaddr:
+                from filodb_tpu.grpcsvc import GrpcShardGroup
+                local.append(GrpcShardGroup(node, gaddr, self.dataset,
+                                            group))
+            else:
+                local.append(RemoteShardGroup(node, self.peers[node],
+                                              self.dataset, group))
         return local
 
     # -- materialization -------------------------------------------------
@@ -640,8 +654,13 @@ class QueryPlanner:
         if fw is None:
             return None
         query, start, step, end = fw
-        from filodb_tpu.parallel.cluster import PromQlRemoteExec
         g = shards[0]
+        gaddr = self.grpc_peers.get(g.node_id)
+        if gaddr:
+            from filodb_tpu.grpcsvc import GrpcRemoteExec
+            return GrpcRemoteExec(query, start, step, end, g.node_id,
+                                  gaddr, g.dataset, stats=self.stats)
+        from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end, g.node_id,
                                 g.base_url, g.dataset, stats=self.stats)
 
@@ -693,6 +712,13 @@ class QueryPlanner:
         if fw is None:
             return None
         query, start, step, end = fw
+        gaddr = self.grpc_partitions.get(ws)
+        if gaddr:
+            from filodb_tpu.grpcsvc import GrpcRemoteExec
+            return GrpcRemoteExec(query, start, step, end,
+                                  f"partition:{gaddr}", gaddr,
+                                  self.dataset, stats=self.stats,
+                                  local_only=False)
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end,
                                 f"partition:{url}", url, self.dataset,

@@ -93,6 +93,20 @@ DEFAULTS = {
     "card-quotas": {},
     "failure-detect-interval-s": 0.5,
     "failure-detect-threshold": 3,
+    # gRPC query service port (PromQLGrpcServer.scala; 0 = ephemeral,
+    # None = off). Peers advertise theirs via "grpc-peers":
+    # {node_id: "host:port"} — leaf dispatch and pushdown then ride
+    # protobuf + NibblePack over persistent channels instead of JSON.
+    "grpc-port": None,
+    "grpc-peers": {},
+    "grpc-partitions": {},
+    # elastic recovery (ShardManager.scala:28 assignShardsToNodes): when a
+    # peer stays DOWN this many seconds past detection, survivors adopt
+    # its shards — bootstrap from the ColumnStore, replay the stream from
+    # the checkpoint watermark, then serve them. None = survive-only
+    # (buddy failover still applies). Requires the shared data-dir /
+    # stream-dir deployment (the Cassandra/Kafka analogue).
+    "shard-reassign-grace-s": None,
 }
 
 
@@ -116,6 +130,41 @@ class FiloServer:
         self.detector = None
         self.node_id: str = self.config["node-id"]
         self.owned_shards: list = []
+        # elastic-recovery bookkeeping: dead node -> shards THIS node
+        # adopted; shard -> replaying driver; node -> original assignment
+        self._adopted: Dict[str, list] = {}
+        self._reassign_lock = __import__("threading").Lock()
+        self._adopted_drivers: Dict[int, object] = {}
+        self._original_shards: Dict[str, list] = {}
+        self._gw_streams: Dict[int, object] = {}
+
+    def _make_shard(self, shard: int):
+        """One shard's full construction — tracker with quota overrides,
+        flush-downsampler, store setup + bootstrap. Shared by startup and
+        elastic adoption so adopted shards cannot silently diverge."""
+        from filodb_tpu.core.cardinality import CardinalityTracker
+        tracker = CardinalityTracker(
+            tuple(self.config.get("card-default-quotas", ())))
+        for pfx, quota in dict(
+                self.config.get("card-quotas") or {}).items():
+            tracker.set_quota([p for p in pfx.split(",") if p],
+                              int(quota))
+        self.card_trackers[shard] = tracker
+        fds = None
+        if self.config.get("flush-downsample") \
+                and self.store.column_store is not None:
+            from filodb_tpu.downsample.flush import FlushDownsampler
+            fds = FlushDownsampler(
+                self.store.column_store, self.config["dataset"], shard,
+                DEFAULT_SCHEMAS,
+                resolutions=tuple(self.config["downsample-resolutions"]))
+        return self.store.setup(
+            self.ref, shard,
+            num_groups=self.config["groups-per-shard"],
+            max_chunk_rows=self.config["max-chunks-size"],
+            bootstrap=self.store.column_store is not None,
+            card_tracker=tracker,
+            flush_downsampler=fds)
 
     def start(self) -> "FiloServer":
         n = self.config["num-shards"]
@@ -134,41 +183,25 @@ class FiloServer:
             dict(self.config.get("spread-overrides") or {}))
         self.card_trackers = {}
         for shard in self.owned_shards:
-            tracker = CardinalityTracker(
-                tuple(self.config.get("card-default-quotas", ())))
-            for pfx, quota in dict(
-                    self.config.get("card-quotas") or {}).items():
-                tracker.set_quota([p for p in pfx.split(",") if p],
-                                  int(quota))
-            self.card_trackers[shard] = tracker
-            fds = None
-            if self.config.get("flush-downsample") \
-                    and self.store.column_store is not None:
-                from filodb_tpu.downsample.flush import FlushDownsampler
-                fds = FlushDownsampler(
-                    self.store.column_store, self.config["dataset"], shard,
-                    DEFAULT_SCHEMAS,
-                    resolutions=tuple(
-                        self.config["downsample-resolutions"]))
-            self.store.setup(
-                self.ref, shard,
-                num_groups=self.config["groups-per-shard"],
-                max_chunk_rows=self.config["max-chunks-size"],
-                bootstrap=self.store.column_store is not None,
-                card_tracker=tracker,
-                flush_downsampler=fds)
+            self._make_shard(shard)
         if num_nodes > 1:
             for i in range(num_nodes):
-                for shard in shards_for_ordinal(i, num_nodes, n):
+                owned_i = shards_for_ordinal(i, num_nodes, n)
+                self._original_shards[f"node{i}"] = list(owned_i)
+                for shard in owned_i:
                     self.mapper.assign(shard, f"node{i}")
         else:
             assign_shards_evenly(self.mapper, [self.node_id])
         streaming = bool(self.config.get("stream-dir"))
-        if not streaming:
-            # peers start ACTIVE optimistically; the failure detector
-            # flips them DOWN when health checks fail
-            for shard in range(n) if num_nodes > 1 else self.owned_shards:
-                self.mapper.activate(shard)
+        # peer shards start ACTIVE optimistically; the failure detector
+        # flips them DOWN when health checks fail. Own shards activate
+        # immediately only without streaming (the ingestion drivers take
+        # them through RECOVERY -> ACTIVE otherwise).
+        owned = set(self.owned_shards)
+        for shard in range(n) if num_nodes > 1 else self.owned_shards:
+            if shard in owned and streaming:
+                continue
+            self.mapper.activate(shard)
         if self.backend is None:
             try:
                 from filodb_tpu.query.tpu import TpuBackend
@@ -213,18 +246,34 @@ class FiloServer:
             buddies=dict(self.config.get("buddy-peers") or {}),
             partitions=dict(self.config.get("partitions") or {}),
             local_partitions=list(
-                self.config.get("local-partitions") or ()))
+                self.config.get("local-partitions") or ()),
+            grpc_peers={k: v for k, v in dict(
+                self.config.get("grpc-peers") or {}).items()
+                if k != self.node_id},
+            grpc_partitions=dict(
+                self.config.get("grpc-partitions") or {}))
         self.http.start()
+        self.grpc_server = None
+        if self.config.get("grpc-port") is not None:
+            from filodb_tpu.grpcsvc import GrpcQueryServer
+            self.grpc_server = GrpcQueryServer(
+                self.http, port=int(self.config["grpc-port"])).start()
+            self.http.grpc_server = self.grpc_server   # /metrics gauge
         if peers:
             from filodb_tpu.parallel.cluster import FailureDetector
             shards_by_node = {node: self.mapper.shards_for_node(node)
                               for node in peers}
+            grace = self.config.get("shard-reassign-grace-s")
             self.detector = FailureDetector(
                 self.mapper, peers, shards_by_node,
                 interval_s=float(self.config.get(
                     "failure-detect-interval-s", 0.5)),
                 threshold=int(self.config.get(
-                    "failure-detect-threshold", 3))).start()
+                    "failure-detect-threshold", 3)),
+                reassign_grace_s=(float(grace) if grace is not None
+                                  else None),
+                on_node_down=self._on_node_down,
+                on_node_up=self._on_node_up).start()
         if streaming:
             self._start_ingestion()
         return self
@@ -253,11 +302,145 @@ class FiloServer:
             self.drivers.append(drv.start())
         if self.config.get("gateway-port") is not None:
             from filodb_tpu.gateway.server import GatewayServer
+            # the gateway is the producer edge: in multi-node mode it
+            # publishes to EVERY shard's stream (kafka/KafkaContainerSink
+            # writes all partitions), not just this node's consumer set.
+            # One gateway process per stream set — frames are appended
+            # whole, but two gateways on one log would interleave.
+            gw_streams = dict(self.streams)
+            if int(self.config.get("num-nodes", 1)) > 1:
+                for shard in range(n):
+                    if shard not in gw_streams:
+                        path = os.path.join(stream_dir, f"shard={shard}",
+                                            "stream.log")
+                        gw_streams[shard] = LogIngestionStream(
+                            path, DEFAULT_SCHEMAS)
+            self._gw_streams = gw_streams
             self.gateway = GatewayServer(
-                self.streams, DEFAULT_SCHEMAS, num_shards=n,
+                gw_streams, DEFAULT_SCHEMAS, num_shards=n,
                 spread=int(self.config.get("default-spread", 1)),
                 spread_provider=self.spread_provider,
                 port=int(self.config["gateway-port"])).start()
+
+    # -- elastic recovery (shard reassignment on node loss) ---------------
+    # ShardManager.scala:28 assignShardsToNodes / IngestionActor.scala:297
+    # recovery protocol: every survivor independently computes the same
+    # round-robin table; the shard's new owner bootstraps index + chunks
+    # from the ColumnStore, replays the shared stream log from the
+    # checkpoint watermark (RECOVERY with progress), then serves it.
+
+    def _on_node_down(self, node: str) -> None:
+        import threading
+
+        from filodb_tpu.parallel.cluster import reassign_dead_shards
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        dead = sorted(self.mapper.shards_for_node(node))
+        if not dead:
+            return
+        survivors = [self.node_id] + (self.detector.alive_peers()
+                                      if self.detector else [])
+        table = reassign_dead_shards(dead, survivors)
+        with self._reassign_lock:
+            self._adopted[node] = []
+        mine = []
+        for sh, owner in table.items():
+            self.mapper.assign(sh, owner)
+            if owner == self.node_id:
+                self.mapper.update(sh, ShardStatus.RECOVERY, owner)
+                mine.append(sh)
+            else:
+                # another survivor adopts it; mark ACTIVE optimistically
+                # (no cross-node status gossip — the failure detector
+                # health-checks that owner and flips DOWN if it dies)
+                self.mapper.update(sh, ShardStatus.ACTIVE, owner)
+
+        def adopt_all():
+            # off the detector's poll thread: ColumnStore bootstrap can
+            # take long, and health checks must keep running meanwhile
+            for sh in mine:
+                with self._reassign_lock:
+                    if node not in self._adopted:
+                        return           # owner came back mid-adoption
+                try:
+                    self._adopt_shard(sh)
+                    with self._reassign_lock:
+                        if node in self._adopted:
+                            self._adopted[node].append(sh)
+                            continue
+                    # owner recovered while we bootstrapped: hand back
+                    self._release_shard(sh)
+                except Exception:
+                    self._release_shard(sh)      # drop partial state
+                    self.mapper.update(sh, ShardStatus.ERROR,
+                                       self.node_id)
+        threading.Thread(target=adopt_all, daemon=True,
+                         name=f"adopt-{node}").start()
+
+    def _on_node_up(self, node: str) -> None:
+        import threading
+
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        with self._reassign_lock:
+            mine = self._adopted.pop(node, [])
+        # hand every reassigned shard back to its original owner (each
+        # node recomputes identically; the returned node re-bootstraps
+        # from the shared store + streams on its own startup)
+        for sh in self._original_shards.get(node, []):
+            self.mapper.assign(sh, node)
+            self.mapper.update(sh, ShardStatus.ACTIVE, node)
+
+        def release_all():
+            # off the poll thread: driver stops join + flush (the same
+            # reason adoption runs in the background)
+            for sh in mine:
+                self._release_shard(sh)
+        if mine:
+            threading.Thread(target=release_all, daemon=True,
+                             name=f"release-{node}").start()
+
+    def _adopt_shard(self, shard: int) -> None:
+        import os
+
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        self.mapper.update(shard, ShardStatus.RECOVERY, self.node_id)
+        self._make_shard(shard)
+        # publish the widened local shard list to the HTTP layer (atomic
+        # rebind; request handlers read the dict per request)
+        self.http.shards_by_dataset[self.ref.dataset] = \
+            self.store.shards(self.ref)
+        if self.config.get("stream-dir"):
+            from filodb_tpu.ingest import (IngestionDriver,
+                                           LogIngestionStream)
+            path = os.path.join(self.config["stream-dir"],
+                                f"shard={shard}", "stream.log")
+            stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+            self.streams[shard] = stream     # gateway routes to it too
+            drv = IngestionDriver(
+                self.store.get_shard(self.ref, shard), stream,
+                mapper=self.mapper,
+                flush_every_records=self.config.get("flush-every-records"),
+                flush_interval_s=float(
+                    self.config.get("flush-interval-s", 2.0)),
+                max_resident_samples=int(
+                    self.config.get("max-resident-samples", 0)))
+            self._adopted_drivers[shard] = drv.start()
+        else:
+            self.mapper.update(shard, ShardStatus.ACTIVE, self.node_id)
+
+    def _release_shard(self, shard: int) -> None:
+        drv = self._adopted_drivers.pop(shard, None)
+        if drv is not None:
+            drv.stop()
+        stream = self.streams.pop(shard, None)
+        if stream is not None:
+            try:
+                stream.close()
+            except OSError:
+                pass
+        self.card_trackers.pop(shard, None)
+        self.store.remove_shard(self.ref, shard)
+        self.http.shards_by_dataset[self.ref.dataset] = \
+            self.store.shards(self.ref)
 
     def seed_dev_data(self, n_samples: int = 360, n_instances: int = 4,
                       start_ms: Optional[int] = None) -> int:
@@ -286,14 +469,23 @@ class FiloServer:
         return rows
 
     def stop(self) -> None:
+        if getattr(self, "grpc_server", None) is not None:
+            self.grpc_server.stop()
         if self.detector is not None:
             self.detector.stop()
         if self.gateway is not None:
             self.gateway.stop()
+        for drv in list(self._adopted_drivers.values()):
+            drv.stop()
         for drv in self.drivers:
             drv.stop()
         for stream in self.streams.values():
             stream.close()
+        for shard, stream in self._gw_streams.items():
+            # close by OBJECT identity: an adopted shard put a different
+            # stream object in self.streams for the same path
+            if stream is not self.streams.get(shard):
+                stream.close()
         if self.http:
             self.http.stop()
 
@@ -332,7 +524,10 @@ def main(argv=None) -> int:
         print(f"seeded {rows} dev samples", file=sys.stderr)
     # machine-readable startup line (test harness / dev scripts read this)
     gw = server.gateway.port if server.gateway is not None else None
-    print(json.dumps({"port": server.port, "gateway_port": gw}), flush=True)
+    gp = server.grpc_server.port if getattr(server, "grpc_server", None) \
+        is not None else None
+    print(json.dumps({"port": server.port, "gateway_port": gw,
+                      "grpc_port": gp}), flush=True)
     print(f"filodb-tpu server listening on :{server.port}", file=sys.stderr)
     try:
         while True:
