@@ -131,7 +131,7 @@ def main():
 
     np.asarray(many(jnp.int64(0), v_p, onehot))   # compile
     runs = []
-    for i in range(7):
+    for i in range(5):
         # the tunnel's host-sync floor drifts tens of ms between reps;
         # sample it fresh right before each measurement
         floor = min(_timed(lambda: np.asarray(noop(jnp.float32(j))))
@@ -169,6 +169,15 @@ def main():
     chips = 8
     est_full_ms = full_samples / chips / device_sps * 1000.0
 
+    # free the query tiles, then fold the ingest + downsample-batch
+    # regression guards into the same driver-captured line (BASELINE.md
+    # targets #2/#3; jmh IngestionBenchmark + spark BatchDownsampler)
+    del v_p, tiles
+    import bench_downsample
+    import bench_ingest
+    ing = bench_ingest.measure()
+    ds = bench_downsample.measure(batches_total=1, reps=1)
+
     print(json.dumps({
         "metric": "rate_sum_by_samples_scanned_per_sec",
         "value": round(device_sps),
@@ -178,6 +187,10 @@ def main():
         "shape": f"{S}x{N} (8h@10s), T={T}, window=5m",
         "hbm_read_gbps": round(hbm_gbps, 1),
         "northstar_est_ms_v5e8": round(est_full_ms, 1),
+        "ingest_samples_per_s": ing["value"],
+        "ingest_encode_samples_per_s": ing["encode_samples_per_s"],
+        "downsample_samples_per_s": ds["value"],
+        "downsample_batch_samples": ds["total_samples"],
     }))
 
 

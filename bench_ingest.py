@@ -42,7 +42,7 @@ def _containers():
     return b.containers()
 
 
-def main():
+def measure():
     conts = _containers()
     total = sum(len(c) for c in conts)
 
@@ -73,7 +73,11 @@ def main():
         "samples": total,
         "native_codec": nbp._native is not None,
     }
-    print(json.dumps(out))
+    return out
+
+
+def main():
+    print(json.dumps(measure()))
 
 
 if __name__ == "__main__":

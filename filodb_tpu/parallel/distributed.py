@@ -1,0 +1,122 @@
+"""Multi-host device mesh: jax.distributed over ICI/DCN.
+
+The reference scales out with one Akka/NCCL process per node
+(coordinator/FilodbCluster.scala:39); the TPU-native equivalent is a
+single jax.distributed job spanning hosts — every process contributes
+its local devices to ONE global ('shard','time') mesh, and the psum /
+all_gather collectives of the windowed aggregate then ride ICI (or DCN
+across hosts) exactly as on one host (SURVEY §7 step 6; the
+"How to Scale Your Model" recipe: pick a mesh, annotate shardings, let
+XLA insert the collectives).
+
+``init_process`` wires one process into the cluster;
+``window_aggregate_distributed`` runs MeshExecutor's fused windowed
+aggregate with every process holding only ITS shard groups' data —
+global arrays are assembled from process-local tiles, so no host ever
+materializes another host's samples.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def init_process(coordinator_address: str, num_processes: int,
+                 process_id: int) -> None:
+    """Join this process to the jax.distributed cluster. Call BEFORE any
+    jax backend initialization (on CPU test rigs also set
+    XLA_FLAGS=--xla_force_host_platform_device_count=K and
+    jax_platforms=cpu first — see tests/test_distributed.py)."""
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def window_aggregate_distributed(mesh_ex, local_series_by_shard,
+                                 local_group_ids, params, func: str,
+                                 agg: str, window_ms: int,
+                                 num_groups: int, offset_ms: int = 0,
+                                 scalar: float = 0.0) -> np.ndarray:
+    """Run MeshExecutor's windowed aggregate across processes.
+
+    Each process passes the shard groups its LOCAL devices own (their
+    count must equal this process's slice of the mesh 'shard' axis); the
+    packed tiles are stitched into global arrays sharded over the mesh,
+    so the grouped psum-tree reduction crosses process boundaries on the
+    wire, not through any host. Returns the full [num_groups, T] result
+    on every process."""
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from filodb_tpu.parallel.mesh import _GATHER_FUNCS, pack_sharded
+    from filodb_tpu.query.tpu import TpuBackend
+
+    mesh = mesh_ex.mesh
+    n_shard = mesh.shape["shard"]
+    n_time = mesh.shape["time"]
+    nproc = jax.process_count()
+    if n_shard % nproc:
+        raise ValueError(f"shard axis {n_shard} must divide across "
+                         f"{nproc} processes")
+    if len(local_series_by_shard) != n_shard // nproc:
+        raise ValueError("pass exactly this process's shard groups")
+
+    # agree on global pad shapes + window bound (static jit args must
+    # match across processes or the compiled programs diverge)
+    local_maxs = max([1] + [len(r) for r in local_series_by_shard])
+    local_maxn = max([1] + [s.ts.size for row in local_series_by_shard
+                            for s in row])
+    w_local = 0
+    if func in _GATHER_FUNCS:
+        all_local = [s for row in local_series_by_shard for s in row]
+        w_local = TpuBackend._window_sample_bound(all_local, window_ms,
+                                                  local_maxn)
+    agreed = multihost_utils.process_allgather(
+        np.array([local_maxs, local_maxn, w_local], np.int64))
+    s_pad = int(agreed[:, 0].max())
+    n_pad = int(agreed[:, 1].max())
+    w_bound = int(agreed[:, 2].max())
+    # pow2 bucketize like pack_sharded's defaults (compile-cache reuse)
+    s_pad = 1 << (s_pad - 1).bit_length()
+    n_pad = 1 << (n_pad - 1).bit_length()
+
+    ts, vals, lens, _ = pack_sharded(local_series_by_shard,
+                                     drop_nan=(func != "last_sample"),
+                                     s_pad=s_pad, n_pad=n_pad)
+    gl = len(local_series_by_shard)
+    gids = np.full((gl, s_pad), -1, dtype=np.int32)
+    for g, row in enumerate(local_group_ids):
+        gids[g, :len(row)] = row
+
+    steps = params.steps
+    T = steps.size
+    T_pad = -(-T // n_time) * n_time
+    step = np.int64(params.step_ms if T > 1 else 1)
+    w0e = np.int64(steps[0] - offset_ms)
+    w0s = np.int64(w0e - window_ms)
+
+    def to_global(arr, spec):
+        return multihost_utils.host_local_array_to_global_array(
+            arr, mesh, spec)
+
+    g_ts = to_global(ts, P("shard", None, None))
+    g_vals = to_global(vals, P("shard", None, None))
+    g_lens = to_global(lens, P("shard", None))
+    g_gids = to_global(gids, P("shard", None))
+
+    out = mesh_ex._step(func, agg, num_groups, T_pad // n_time, w_bound,
+                        g_ts, g_vals, g_lens, g_gids, w0s, w0e, step,
+                        scalar)
+    # [num_groups, T_pad] sharded over 'time': recover the full grid on
+    # every host (with the default shard-only mesh the time axis is
+    # whole already; a time-split mesh gathers process slices in order)
+    host = np.asarray(multihost_utils.global_array_to_host_local_array(
+        out, mesh, P(None, "time")))
+    if host.shape[1] != T_pad:
+        host = np.concatenate(
+            list(multihost_utils.process_allgather(host)), axis=1)
+    return host[:, :T]

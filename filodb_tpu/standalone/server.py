@@ -495,6 +495,17 @@ class FiloServer:
 
 
 def main(argv=None) -> int:
+    # honor JAX_PLATFORMS even where a sitecustomize pre-imports jax
+    # pointed at an accelerator (env alone is too late then; the config
+    # update still works before first backend init)
+    import os
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
     p = argparse.ArgumentParser(prog="filodb-tpu-server")
     p.add_argument("--config", help="JSON config file")
     p.add_argument("--port", type=int)
