@@ -51,12 +51,18 @@ def measure(batches_total=BATCHES, reps=2):
     WB1H = 16        # 12 sub-periods per hour
 
     def both(b):
-        """Finest level from raw, 1h cascaded from 5m (the job's shape)."""
-        fine = kernels.downsample_gauge_tiles(b[0], b[1], b[2], base,
-                                              np.int64(res5), nper5, WB5)
-        coarse = kernels.cascade_gauge(fine, base, np.int64(res1h),
-                                       nper1h, WB1H)
+        """Finest level from raw, 1h cascaded from 5m (the job's shape).
+        Regular-cadence reshape path (the gather kernel is the ragged
+        fallback; cadence passed explicitly — the generator guarantees
+        it, and the host gate would pull the ts tile over the tunnel)."""
+        fine = kernels.downsample_gauge_fast(
+            b[0], b[1], b[2], base, res5, nper5, cadence=(DT, DT))
+        coarse = kernels.cascade_gauge_aligned(fine, res1h // res5, 0)
         return fine, coarse
+
+    @jax.jit
+    def _checksum(fine0, coarse0):
+        return jnp.nansum(fine0[:8]) + jnp.nansum(coarse0[:8])
 
     t0c = time.perf_counter()
     # a few resident batches (8 would exceed HBM), alternated —
@@ -64,7 +70,10 @@ def measure(batches_total=BATCHES, reps=2):
     batches = [jax.block_until_ready(_gen_batch(i))
                for i in range(min(2, batches_total))]
     f, c = both(batches[0])
-    np.asarray(f[0][:2, :2]), np.asarray(c[0][:2, :2])   # compile + sync
+    # compile EVERYTHING outside the timed region, including the
+    # checksum sync op — over the axon tunnel an op-by-op compile costs
+    # seconds and would dominate the measurement
+    float(np.asarray(_checksum(f[0], c[0])))
     compile_s = time.perf_counter() - t0c
 
     best = float("inf")
@@ -74,8 +83,7 @@ def measure(batches_total=BATCHES, reps=2):
         for i in range(batches_total):
             b = batches[i % len(batches)]
             fine, coarse = both(b)
-            acc += float(np.asarray(jnp.nansum(fine[0][:8])
-                                    + jnp.nansum(coarse[0][:8])))  # sync
+            acc += float(np.asarray(_checksum(fine[0], coarse[0])))  # sync
         best = min(best, time.perf_counter() - t0)
     total = S * N * batches_total
     sps = total / best

@@ -77,7 +77,8 @@ class TimeSeriesPartition:
     published to ``chunks``."""
 
     __slots__ = ("part_id", "part_key", "schema", "chunks", "_ts_buf",
-                 "_col_bufs", "_hist_scheme", "max_chunk_rows", "_chunk_seq",
+                 "_col_bufs", "_buf_rows", "_hist_scheme",
+                 "max_chunk_rows", "_chunk_seq",
                  "ingested", "ooo_dropped", "_decode_cache", "_merge_cache",
                  "persisted_chunks", "odp_pending", "_cache_lock",
                  "card_active", "on_encode")
@@ -88,8 +89,12 @@ class TimeSeriesPartition:
         self.part_key = part_key
         self.schema = schema
         self.chunks: List[ChunkSetInfo] = []
-        self._ts_buf: List[int] = []
+        # write buffers are SEGMENT lists: each ingest run appends one
+        # numpy array slice (no per-row Python element churn); histogram
+        # columns keep per-row [nb] arrays. Row count tracked separately.
+        self._ts_buf: List[np.ndarray] = []
         self._col_bufs: List[List] = [[] for _ in schema.data_columns]
+        self._buf_rows = 0
         self._hist_scheme = None
         self.max_chunk_rows = max_chunk_rows
         self._chunk_seq = 0
@@ -118,7 +123,7 @@ class TimeSeriesPartition:
         if last is not None and timestamp <= last:
             self.ooo_dropped += 1
             return False
-        self._ts_buf.append(int(timestamp))
+        self._ts_buf.append(np.asarray([int(timestamp)], dtype=np.int64))
         for buf, col, v in zip(self._col_bufs, self.schema.data_columns, values):
             if col.col_type == ColumnType.HISTOGRAM:
                 scheme, counts = v
@@ -126,9 +131,10 @@ class TimeSeriesPartition:
                     self._hist_scheme = scheme
                 buf.append(np.asarray(counts, dtype=np.int64))
             else:
-                buf.append(float(v))
+                buf.append(np.asarray([v], dtype=np.float64))
+        self._buf_rows += 1
         self.ingested += 1
-        if len(self._ts_buf) >= self.max_chunk_rows:
+        if self._buf_rows >= self.max_chunk_rows:
             self.switch_buffers()
         return True
 
@@ -137,10 +143,11 @@ class TimeSeriesPartition:
         """Append a run of rows for this partition in one shot.
 
         Fast path: a strictly-increasing run starting after the current
-        last timestamp extends the write buffers with C-level list
-        extension (the batched analogue of the reference's per-row
-        appender adds). Anything else falls back to the per-row path so
-        OOO-drop semantics stay identical. Returns rows ingested."""
+        last timestamp lands as whole numpy SEGMENTS in the write
+        buffers — O(1) Python work per run, no per-row element churn
+        (the batched analogue of the reference's per-row appender adds).
+        Anything else falls back to the per-row path so OOO-drop
+        semantics stay identical. Returns rows ingested."""
         n_in = len(timestamps)
         if n_in == 0:
             return 0
@@ -160,31 +167,37 @@ class TimeSeriesPartition:
             return n
         hist_cols = [i for i, c in enumerate(self.schema.data_columns)
                      if c.col_type == ColumnType.HISTOGRAM]
+        col_arrays = [None if ci in hist_cols
+                      else np.asarray(col_values[ci], dtype=np.float64)
+                      for ci in range(len(self._col_bufs))]
         pos = 0
         while pos < n_in:
-            room = self.max_chunk_rows - len(self._ts_buf)
+            room = self.max_chunk_rows - self._buf_rows
             take = min(room, n_in - pos)
-            self._ts_buf.extend(int(t) for t in timestamps[pos:pos + take])
+            # copy: a view would pin the container's WHOLE column array
+            # in memory for as long as any segment sits in the buffer
+            self._ts_buf.append(np.array(ts[pos:pos + take]))
             for ci, buf in enumerate(self._col_bufs):
-                vals = col_values[ci]
                 if ci in hist_cols:
+                    vals = col_values[ci]
                     for k in range(pos, pos + take):
                         scheme, counts = vals[k]
                         if self._hist_scheme is None:
                             self._hist_scheme = scheme
                         buf.append(np.asarray(counts, dtype=np.int64))
                 else:
-                    buf.extend(vals[pos:pos + take])
+                    buf.append(np.array(col_arrays[ci][pos:pos + take]))
+            self._buf_rows += take
             pos += take
-            if len(self._ts_buf) >= self.max_chunk_rows:
+            if self._buf_rows >= self.max_chunk_rows:
                 self.switch_buffers()
         self.ingested += n_in
         return n_in
 
     @property
     def last_timestamp(self) -> Optional[int]:
-        if self._ts_buf:
-            return self._ts_buf[-1]
+        if self._buf_rows:
+            return int(self._ts_buf[-1][-1])
         if self.chunks:
             return self.chunks[-1].end_ts
         return None
@@ -193,15 +206,15 @@ class TimeSeriesPartition:
     def earliest_timestamp(self) -> Optional[int]:
         if self.chunks:
             return self.chunks[0].start_ts
-        return self._ts_buf[0] if self._ts_buf else None
+        return int(self._ts_buf[0][0]) if self._buf_rows else None
 
     def switch_buffers(self) -> Optional[ChunkSetInfo]:
         """Encode the current write buffer into an immutable chunk
         (TimeSeriesPartition.scala:229 switchBuffers / :248 encodeOneChunkset).
         """
-        if not self._ts_buf:
+        if not self._buf_rows:
             return None
-        ts = np.asarray(self._ts_buf, dtype=np.int64)
+        ts = np.concatenate(self._ts_buf)
         vecs: List[bytes] = [bv.encode_longs(ts)]
         for buf, col in zip(self._col_bufs, self.schema.data_columns):
             if col.col_type == ColumnType.HISTOGRAM:
@@ -210,7 +223,8 @@ class TimeSeriesPartition:
                     self._hist_scheme, rows, counter=col.counter))
             else:
                 vecs.append(bv.encode_doubles(
-                    np.asarray(buf, dtype=np.float64),
+                    np.concatenate(buf) if buf
+                    else np.zeros(0, dtype=np.float64),
                     counter=col.detect_drops))
         info = ChunkSetInfo(
             id=chunk_id(int(ts[0]), self._chunk_seq),
@@ -226,6 +240,7 @@ class TimeSeriesPartition:
             self.chunks.append(info)
             self._ts_buf = []
             self._col_bufs = [[] for _ in self.schema.data_columns]
+            self._buf_rows = 0
         if self.on_encode is not None:
             # flush-time downsample emission rides every encode, including
             # buffer-full encodes during ingest (ShardDownsampler.scala:40)
@@ -234,16 +249,28 @@ class TimeSeriesPartition:
 
     # -- read path --------------------------------------------------------
     def buffer_snapshot(self):
-        """Snapshot of the un-encoded tail (timestamps, per-column lists).
+        """Snapshot of the un-encoded tail: (ts array, per-column tails —
+        float64 arrays for plain columns, per-row lists for histograms).
 
-        Ingest appends the timestamp first, then each column value, so the
-        longest consistent prefix across all buffers is a valid row set even
-        when a writer thread is mid-append."""
-        ts = list(self._ts_buf)
-        cols = [list(b) for b in self._col_bufs]
-        n = min([len(ts)] + [len(c) for c in cols]) if cols else len(ts)
-        return (np.asarray(ts[:n], dtype=np.int64),
-                [c[:n] for c in cols])
+        Ingest appends the timestamp segment first, then each column
+        segment, so the longest consistent prefix across all buffers is a
+        valid row set even when a writer thread is mid-append."""
+        ts_segs = list(self._ts_buf)
+        ts = (np.concatenate(ts_segs) if ts_segs
+              else np.zeros(0, dtype=np.int64))
+        snaps, counts = [], []
+        for buf, col in zip(self._col_bufs, self.schema.data_columns):
+            b = list(buf)
+            if col.col_type == ColumnType.HISTOGRAM:
+                snaps.append(b)
+                counts.append(len(b))
+            else:
+                arr = (np.concatenate(b) if b
+                       else np.zeros(0, dtype=np.float64))
+                snaps.append(arr)
+                counts.append(arr.size)
+        n = min([ts.size] + counts) if counts else ts.size
+        return ts[:n], [c[:n] for c in snaps]
 
     def _decoded_chunk_arrays(self, col_index: int
                               ) -> Tuple[np.ndarray, np.ndarray]:
@@ -494,20 +521,11 @@ class TimeSeriesShard:
         emit per-series bursts), so the per-partition hot path is one
         batched buffer extension instead of a per-row Python loop."""
         n = 0
-        pks = container.part_keys
-        tss = container.timestamps
-        cols = container.columns
-        total = len(tss)
-        i = 0
-        while i < total:
-            j = i + 1
-            pk = pks[i]
-            while j < total and (pks[j] is pk or pks[j] == pk):
-                j += 1
+        tss, cols = container.arrays()
+        for i, j, pk in container.runs():
             part = self.get_or_create_partition(pk, tss[i])
             if part is None:
                 self.stats.rows_skipped += j - i
-                i = j
                 continue
             if not part.card_active:
                 # resumed ingest into a recovered/evicted shell
@@ -535,7 +553,6 @@ class TimeSeriesShard:
                 if last is not None:
                     self.index.update_end_time(part.part_id, last)
             self.stats.out_of_order_dropped += (j - i) - got
-            i = j
         self.stats.rows_ingested += n
         if offset >= 0:
             # conservative: record offset against all groups on explicit flush
@@ -691,7 +708,7 @@ class TimeSeriesShard:
         """Full rescan (tests / forensic cross-check of the counter)."""
         n = 0
         for p in self.partitions.values():
-            n += sum(c.num_rows for c in p.chunks) + len(p._ts_buf)
+            n += sum(c.num_rows for c in p.chunks) + p._buf_rows
         return n
 
     def ensure_headroom(self, max_samples: int,
@@ -713,7 +730,7 @@ class TimeSeriesShard:
         parts = sorted(
             ((p.last_timestamp, p) for p in self.partitions.values()
              if p.last_timestamp is not None and p.chunks
-             and not p._ts_buf and not p.odp_pending),
+             and not p._buf_rows and not p.odp_pending),
             key=lambda x: x[0])
         freed = 0
         cutoff = None
@@ -737,7 +754,7 @@ class TimeSeriesShard:
         evict = [
             pid for pid, p in self.partitions.items()
             if (p.last_timestamp is not None and p.last_timestamp < cutoff_ts
-                and not p._ts_buf
+                and not p._buf_rows
                 # shells that re-accumulated chunks (resumed ingest after
                 # an earlier eviction) are evictable again; empty shells
                 # have nothing to release
@@ -789,7 +806,7 @@ class TimeSeriesShard:
             for pid in evict:
                 part = self.partitions.pop(pid)
                 self._resident -= sum(c.num_rows for c in part.chunks) \
-                    + len(part._ts_buf)
+                    + part._buf_rows
                 self._by_part_key.pop(part.part_key.to_bytes(), None)
                 if self.card_tracker is not None:
                     self.card_tracker.modify_count(

@@ -180,11 +180,14 @@ class RecordContainer:
 
     Columnar: one numpy array per column, plus per-row partkey references;
     this is the "zero-serialization" analogue — arrays flow straight into the
-    write-buffer appenders."""
+    write-buffer appenders. Same-partition runs are tracked AT ADD TIME
+    (builders emit per-series bursts), so the shard ingest loop walks
+    O(series) runs instead of O(rows) with per-row PartKey comparisons."""
     schema: DataSchema
     part_keys: List[PartKey] = field(default_factory=list)
     timestamps: List[int] = field(default_factory=list)
     columns: List[List] = field(default_factory=list)  # per data column
+    _runs: List = field(default_factory=list)          # [start, end, pk]
 
     def __post_init__(self):
         if not self.columns:
@@ -195,10 +198,53 @@ class RecordContainer:
             raise ValueError(
                 f"expected {len(self.schema.data_columns)} values, "
                 f"got {len(values)}")
+        i = len(self.timestamps)
+        if self._runs and (self._runs[-1][2] is part_key
+                           or self._runs[-1][2] == part_key):
+            self._runs[-1][1] = i + 1
+        else:
+            self._runs.append([i, i + 1, part_key])
         self.part_keys.append(part_key)
         self.timestamps.append(int(timestamp))
         for col, v in zip(self.columns, values):
             col.append(v)
+
+    def arrays(self):
+        """Columnar numpy view of the container: (ts int64 array,
+        per-column float64 arrays — histogram columns stay per-row
+        lists). Cached by row count; run slices of these are zero-copy
+        views, so the per-run ingest cost is O(1)."""
+        n = len(self.timestamps)
+        cached = getattr(self, "_arrays_cache", None)
+        if cached is not None and cached[0] == n:
+            return cached[1], cached[2]
+        ts = np.asarray(self.timestamps, dtype=np.int64)
+        cols = []
+        from filodb_tpu.core.schemas import ColumnType  # cycle-free late
+        for col, vals in zip(self.schema.data_columns, self.columns):
+            if col.col_type == ColumnType.HISTOGRAM:
+                cols.append(vals)
+            else:
+                cols.append(np.asarray(vals, dtype=np.float64))
+        self._arrays_cache = (n, ts, cols)
+        return ts, cols
+
+    def runs(self):
+        """Consecutive same-partition [start, end, pk] runs. Recomputed
+        lazily for containers assembled from raw lists (wire decode)."""
+        if not self._runs and self.timestamps:
+            runs = []
+            pks = self.part_keys
+            i, total = 0, len(pks)
+            while i < total:
+                j = i + 1
+                pk = pks[i]
+                while j < total and (pks[j] is pk or pks[j] == pk):
+                    j += 1
+                runs.append([i, j, pk])
+                i = j
+            self._runs = runs
+        return self._runs
 
     def __len__(self) -> int:
         return len(self.timestamps)
@@ -212,16 +258,25 @@ class RecordContainer:
 
 class RecordBuilder:
     """Builds RecordContainers from label maps + samples, computing shard
-    hashes (RecordBuilder.scala:34 public API surface)."""
+    hashes (RecordBuilder.scala:34 public API surface).
+
+    PartKeys are interned per builder: the same series yields the SAME
+    object, so downstream run detection and partition-map lookups hit the
+    identity fast path instead of re-hashing label tuples per row."""
 
     def __init__(self, schemas: Schemas):
         self.schemas = schemas
         self._containers: Dict[str, RecordContainer] = {}
+        self._pk_intern: Dict[Tuple[int, Tuple], PartKey] = {}
 
     def add_sample(self, schema_name: str, labels: Mapping[str, str],
                    timestamp: int, *values) -> PartKey:
         schema = self.schemas.by_name(schema_name)
-        pk = PartKey.make(schema, labels)
+        key = (schema.schema_id, tuple(sorted(labels.items())))
+        pk = self._pk_intern.get(key)
+        if pk is None:
+            pk = PartKey(key[0], key[1])
+            self._pk_intern[key] = pk
         cont = self._containers.setdefault(schema_name, RecordContainer(schema))
         cont.add(pk, timestamp, *values)
         return pk

@@ -218,22 +218,33 @@ class DownsamplerJob:
         ts_pad, vals_pad, lens, t_lo, t_hi = self._pack(batch)
         if t_lo is None:
             return
-        prev = prev_res = None
+        rc = kernels.regular_cadence(ts_pad, lens,
+                                     int(min(self.resolutions)))
+        prev = prev_res = prev_base = None
         for res in sorted(self.resolutions):
             base = (t_lo // res) * res
             nperiods = int((t_hi - base) // res) + 1
-            if prev is not None and res % prev_res == 0:
-                wb = _next_pow2(res // prev_res + 2, 4)
-                arrays = kernels.cascade_gauge(prev, np.int64(base),
-                                               np.int64(res), nperiods, wb)
+            if prev is not None and res % prev_res == 0 \
+                    and (prev_base - base) % prev_res == 0:
+                # coarser level from the finer one: aligned reshape when
+                # the resolutions nest, gather cascade otherwise
+                arrays = kernels.cascade_gauge_aligned(
+                    prev, res // prev_res,
+                    int((prev_base - base) // prev_res))
             else:
-                wb = self._w_bound(ts_pad, lens, res)
-                arrays = kernels.downsample_gauge_tiles(
-                    ts_pad, vals_pad, lens, np.int64(base), np.int64(res),
-                    nperiods, wb)
+                arrays = None
+                if rc is not None:
+                    arrays = kernels.downsample_gauge_fast(
+                        ts_pad, vals_pad, lens, base, res, nperiods,
+                        cadence=rc)
+                if arrays is None:
+                    wb = self._w_bound(ts_pad, lens, res)
+                    arrays = kernels.downsample_gauge_tiles(
+                        ts_pad, vals_pad, lens, np.int64(base),
+                        np.int64(res), nperiods, wb)
             self._emit_gauge(batch, [np.asarray(a) for a in arrays],
                              dataset, res, shard, out_shards, stats)
-            prev, prev_res = arrays, res
+            prev, prev_res, prev_base = arrays, res, base
 
     def _emit_gauge(self, batch, arrays, dataset, res, shard, out_shards,
                     stats) -> None:

@@ -124,8 +124,16 @@ class GrpcRemoteExec:
         ok = (idx < want.size) & (want[np.clip(idx, 0, want.size - 1)]
                                   == steps)
         out[:, idx[ok]] = values[:, ok]
-        return GridResult(want, keys, out, hist_values=None,
-                          bucket_les=les)
+        # realign histogram columns with the same mapping (dropping them
+        # while keeping bucket_les would hand downstream ops an
+        # inconsistent grid)
+        hv_out = None
+        if hv is not None:
+            hv_out = np.full((hv.shape[0], want.size, hv.shape[2]),
+                             np.nan)
+            hv_out[:, idx[ok], :] = hv[:, ok, :]
+        return GridResult(want, keys, out, hist_values=hv_out,
+                          bucket_les=les if hv_out is not None else None)
 
     def plan_tree(self, indent: int = 0) -> str:
         return (" " * indent + f"GrpcRemoteExec(node={self.node_id}, "

@@ -14,6 +14,7 @@ message codecs live in grpcsvc.wire; no protoc codegen needed."""
 
 from __future__ import annotations
 
+import threading
 from concurrent import futures
 from typing import Optional
 
@@ -32,6 +33,9 @@ class GrpcQueryServer:
         import grpc
         self.http = http_server
         self.rpcs_served = 0
+        # handlers run on ThreadPoolExecutor threads; unsynchronized
+        # `+= 1` would lose increments the /metrics gauge relies on
+        self._rpc_lock = threading.Lock()
         outer = self
 
         class Handler(grpc.GenericRpcHandler):
@@ -66,7 +70,8 @@ class GrpcQueryServer:
 
     def _fetch_raw(self, request: bytes, context) -> bytes:
         from filodb_tpu.query.model import QueryError, QueryStats
-        self.rpcs_served += 1
+        with self._rpc_lock:
+            self.rpcs_served += 1
         try:
             req = wire.decode_raw_request(request)
             series = self.http.leaf_select(
@@ -88,7 +93,8 @@ class GrpcQueryServer:
                                               parse_query_range)
         from filodb_tpu.query.model import (GridResult, QueryError,
                                             ScalarResult)
-        self.rpcs_served += 1
+        with self._rpc_lock:
+            self.rpcs_served += 1
         try:
             req = wire.decode_exec_request(request)
             engine = self.http.make_planner(

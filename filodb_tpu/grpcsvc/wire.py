@@ -1,13 +1,15 @@
 """Protobuf wire codecs for the gRPC query service.
 
-Message shapes mirror the reference's grpc/src/main/protobuf
+This fills the role of the reference's grpc/src/main/protobuf
 (query_service.proto Request/Response, range_vector.proto
-SerializedRangeVector): hand-encoded with the same varint /
-length-delimited field encoding protoc emits, reusing the proven
-primitives from the remote-read implementation. Sample columns ride
-NibblePack (memory/format/NibblePack.scala semantics — delta-packed
-sorted timestamps, XOR-packed doubles), typically 2-6x smaller than the
-base64-JSON control-plane wire they replace.
+SerializedRangeVector) but defines its OWN message schema — the field
+layout below is not interoperable with the reference service; only the
+protobuf encoding primitives (the same varint / length-delimited field
+encoding protoc emits, reused from the remote-read implementation) are
+shared. Sample columns ride NibblePack (memory/format/NibblePack.scala
+semantics — delta-packed sorted timestamps, XOR-packed doubles),
+typically 2-6x smaller than the base64-JSON control-plane wire they
+replace.
 
 Messages (field numbers):
   Filter        {1: label, 2: op, 3: value}

@@ -77,6 +77,9 @@ class FiloHttpServer:
         self.local_partitions = list(local_partitions or ())
         self.grpc_peers = dict(grpc_peers or {})
         self.grpc_partitions = dict(grpc_partitions or {})
+        # set by the standalone server: FailureDetector whose down-view
+        # rides the health body (quorum input for elastic reassignment)
+        self.detector = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -156,7 +159,23 @@ class FiloHttpServer:
     def _route(self, path: str, qs: Dict, body_json=None,
                body_raw: bytes = b""):
         if path in ("/__health", "/__liveness", "/__readiness"):
-            return 200, {"status": "healthy"}
+            # the health body doubles as status gossip: locally-served
+            # shards with their FSM status (peers sync these instead of
+            # optimistically flipping adopted shards ACTIVE), plus this
+            # node's own down-view of its peers (quorum input for
+            # elastic reassignment). FilodbCluster.scala gossip analogue.
+            shards_adv: Dict[str, str] = {}
+            if self.shard_mapper is not None:
+                served = {getattr(s, "shard_num", i)
+                          for lst in self.shards_by_dataset.values()
+                          for i, s in enumerate(lst)}
+                for n in served:
+                    shards_adv[str(n)] = \
+                        self.shard_mapper.status(n).value
+            down = (sorted(self.detector.down_peers())
+                    if self.detector is not None else [])
+            return 200, {"status": "healthy", "shards": shards_adv,
+                         "down_peers": down}
         if path == "/metrics":
             return 200, self._metrics_text()
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
@@ -260,6 +279,8 @@ class FiloHttpServer:
             "execMs": round((t3 - t2) * 1000, 3),
             "plan": type(ex).__name__,
         }
+        if engine.stats.warnings:
+            out["warnings"] = sorted(set(engine.stats.warnings))
         return 200, out
 
     def _query_instant(self, engine, qs):
@@ -273,6 +294,8 @@ class FiloHttpServer:
             return 200, prom_json.scalar(res, instant=True)
         out = prom_json.vector(res)
         out["stats"] = self._query_stats(engine, res)
+        if engine.stats.warnings:
+            out["warnings"] = sorted(set(engine.stats.warnings))
         return 200, out
 
     @staticmethod

@@ -282,16 +282,28 @@ class FailureDetector:
         self._down: Dict[str, bool] = {p: False for p in peers}
         self._down_since: Dict[str, float] = {}
         self._reassigned: Dict[str, bool] = {p: False for p in peers}
+        # status gossip parsed from peers' health bodies: each peer
+        # advertises the FSM status of the shards it actually serves,
+        # and its own down-view of ITS peers (the quorum input)
+        self._peer_shards: Dict[str, Dict[int, str]] = {}
+        self._peer_down_view: Dict[str, set] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _alive(self, url: str) -> bool:
+    def _probe(self, url: str) -> Optional[Dict]:
+        """One health poll: {} on a healthy peer without a parseable
+        body, the parsed body when present, None when unreachable."""
         try:
             with urllib.request.urlopen(f"{url.rstrip('/')}/__health",
                                         timeout=self.timeout_s) as r:
-                return r.status == 200
+                if r.status != 200:
+                    return None
+                try:
+                    return json.loads(r.read())
+                except ValueError:
+                    return {}
         except OSError:
-            return False
+            return None
 
     def is_down(self, node: str) -> bool:
         return self._down.get(node, False)
@@ -299,10 +311,56 @@ class FailureDetector:
     def alive_peers(self) -> List[str]:
         return [p for p in self.peers if not self._down.get(p, False)]
 
+    def down_peers(self) -> List[str]:
+        """This node's own down-view (advertised in its health body so
+        peers can count quorum votes)."""
+        return [p for p, d in self._down.items() if d]
+
+    def _quorum_agrees(self, node: str) -> bool:
+        """Require a majority of this node's OTHER alive peers to share
+        the down-view before elastic reassignment fires — one node's
+        partitioned link must not trigger dual-ingest adoption (the
+        Akka-cluster gossip-convergence analogue, FilodbCluster.scala).
+        With no other alive peer there is no quorum to consult."""
+        voters = [p for p in self.peers
+                  if p != node and not self._down.get(p, False)]
+        if not voters:
+            return True
+        agree = sum(1 for p in voters
+                    if node in self._peer_down_view.get(p, ()))
+        # self + agreeing peers must be a strict majority of self + voters
+        return 2 * (1 + agree) > 1 + len(voters)
+
+    def _sync_peer_statuses(self, node: str, adv: Dict[int, str]) -> None:
+        """Adopt the owner's advertised shard statuses instead of
+        guessing: a shard another survivor adopted stays RECOVERY on
+        every node until its owner advertises it ACTIVE (closes the
+        window where queries hit a bootstrapping adopter and silently
+        return partial results)."""
+        for sh, st_str in adv.items():
+            if self.mapper.node_of(sh) != node:
+                continue
+            try:
+                st = ShardStatus(st_str)
+            except ValueError:
+                continue
+            if self.mapper.status(sh) is not st:
+                self.mapper.update(sh, st, node)
+
     def poll_once(self) -> None:
         for node, url in self.peers.items():
-            if self._alive(url):
+            body = self._probe(url)
+            if body is not None:
                 self._misses[node] = 0
+                adv = {}
+                try:
+                    adv = {int(k): v for k, v in
+                           (body.get("shards") or {}).items()}
+                except (TypeError, ValueError):
+                    pass
+                self._peer_shards[node] = adv
+                self._peer_down_view[node] = set(
+                    body.get("down_peers") or ())
                 if self._down[node]:
                     self._down[node] = False
                     self._down_since.pop(node, None)
@@ -316,11 +374,22 @@ class FailureDetector:
                                 # monitoring thread
                                 pass
                             continue
-                        # no release hook: fall through to the plain
-                        # ACTIVE flip so the recovered node's shards
-                        # don't stay reassigned forever
+                        # no release hook: fall through and hand the
+                        # shards back so they don't stay reassigned
+                        # forever
                     for sh in self.shards_by_node.get(node, []):
-                        self.mapper.update(sh, ShardStatus.ACTIVE, node)
+                        # honor what the returning node ADVERTISES: a
+                        # node mid-replay says "recovery" and must not
+                        # be flipped ACTIVE (queries would lose the
+                        # partial-result warning until the next poll)
+                        try:
+                            st = ShardStatus(adv[sh]) if sh in adv \
+                                else ShardStatus.ACTIVE
+                        except ValueError:
+                            st = ShardStatus.ACTIVE
+                        self.mapper.update(sh, st, node)
+                else:
+                    self._sync_peer_statuses(node, adv)
             else:
                 self._misses[node] += 1
                 if self._misses[node] >= self.threshold \
@@ -332,7 +401,8 @@ class FailureDetector:
                 if (self._down[node] and self.reassign_grace_s is not None
                         and not self._reassigned.get(node, False)
                         and time.monotonic() - self._down_since[node]
-                        >= self.reassign_grace_s):
+                        >= self.reassign_grace_s
+                        and self._quorum_agrees(node)):
                     self._reassigned[node] = True
                     if self.on_node_down is not None:
                         try:

@@ -97,7 +97,9 @@ class Selector:
     matchers: List[Matcher]
     window_ms: Optional[int] = None
     offset_ms: int = 0
-    at_ms: Optional[int] = None
+    # int ms, or "start"/"end" (@ start()/@ end()), resolved against the
+    # query range at plan conversion
+    at_ms: object = None
     column: Optional[str] = None   # FiloDB ::column suffix
 
 
@@ -145,6 +147,9 @@ class Subquery:
     window_ms: int
     step_ms: Optional[int]
     offset_ms: int = 0
+    # int ms, or "start"/"end" (@ start()/@ end()), resolved against the
+    # query range at plan conversion
+    at_ms: object = None
 
 
 @dataclass
@@ -358,16 +363,24 @@ class Parser:
             elif t.text == "@":
                 self.next()
                 at = self.next()
-                at_ms = int(float(at.text) * 1000)
-                if isinstance(e, Selector):
+                if at.text in ("start", "end"):
+                    # @ start() / @ end() (LogicalPlan.scala:349 pins to
+                    # the query range; resolved at plan conversion)
+                    self.expect("(")
+                    self.expect(")")
+                    at_ms: object = at.text
+                else:
+                    sign = 1
+                    if at.text == "-":
+                        sign = -1
+                        at = self.next()
+                    at_ms = sign * int(float(at.text) * 1000)
+                if isinstance(e, (Selector, Subquery)):
                     e.at_ms = at_ms
                 else:
-                    # Prometheus only allows @ on selectors/subqueries;
-                    # rejecting (rather than ignoring) avoids silently
-                    # unpinned answers for subqueries we don't pin yet
                     raise ParseError(
                         "@ modifier is only supported on vector and range "
-                        "selectors")
+                        "selectors and subqueries")
             else:
                 break
         return e
@@ -538,6 +551,15 @@ class PlanBuilder:
     def build(self, ast) -> lp.LogicalPlan:
         return self._vec(ast)
 
+    def _resolve_at(self, at) -> Optional[int]:
+        """@ modifier value -> pinned ms (start()/end() pin to the query
+        range, LogicalPlan.scala:349 / ast/SubqueryUtils)."""
+        if at == "start":
+            return self.start_ms
+        if at == "end":
+            return self.end_ms
+        return at
+
     # -- scalar plans -----------------------------------------------------
     def _scalar(self, ast) -> lp.LogicalPlan:
         if isinstance(ast, NumLit):
@@ -596,7 +618,8 @@ class PlanBuilder:
                 column=ast.column, offset_ms=ast.offset_ms)
             return lp.PeriodicSeries(raw, self.start_ms, self.step_ms,
                                      self.end_ms, self.lookback_ms,
-                                     ast.offset_ms, ast.at_ms)
+                                     ast.offset_ms,
+                                     self._resolve_at(ast.at_ms))
         if isinstance(ast, Agg):
             inner = self._vec(ast.expr)
             params = tuple(self._const(p) for p in ast.params)
@@ -680,13 +703,15 @@ class PlanBuilder:
                 column=rv.column, offset_ms=rv.offset_ms)
             return lp.PeriodicSeriesWithWindowing(
                 raw, fn, rv.window_ms, self.start_ms, self.step_ms,
-                self.end_ms, tuple(scalars), rv.offset_ms, rv.at_ms)
+                self.end_ms, tuple(scalars), rv.offset_ms,
+                self._resolve_at(rv.at_ms))
         if isinstance(rv, Subquery):
             sub_step = rv.step_ms if rv.step_ms else self.step_ms
             inner = self._vec(rv.expr)  # placeholder range; engine rewrites
             return lp.SubqueryWithWindowing(
                 inner, fn, rv.window_ms, sub_step, self.start_ms,
-                self.step_ms, self.end_ms, tuple(scalars), rv.offset_ms)
+                self.step_ms, self.end_ms, tuple(scalars), rv.offset_ms,
+                self._resolve_at(rv.at_ms))
         raise ParseError(f"{name} expects a range vector argument")
 
     def _binop_plan(self, ast: BinOp) -> lp.LogicalPlan:

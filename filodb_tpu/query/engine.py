@@ -983,6 +983,9 @@ class QueryEngine:
         if isinstance(plan, lp.TopLevelSubquery):
             return self._eval(plan.inner)
         if isinstance(plan, lp.Aggregate):
+            fused = self._try_fused_agg(plan)
+            if fused is not None:
+                return fused
             inner = self._eval(plan.inner)
             return aggregate(inner, plan.op, plan.params, tuple(plan.by),
                              tuple(plan.without))
@@ -1029,6 +1032,71 @@ class QueryEngine:
             return series
         raise QueryError(f"cannot execute plan {type(plan).__name__}")
 
+    def _try_fused_agg(self, plan) -> Optional[GridResult]:
+        """`sum/avg/count by (g) (rate/increase/delta(sel[w]))` fused
+        end-to-end on device: grouping happens inside the Pallas
+        group-sum kernel and the [S, T] per-series intermediate never
+        exists (exec/AggrOverRangeVectors map-reduce, fused).
+
+        None is returned only for plan SHAPES this path doesn't own;
+        once the series are selected, any kernel ineligibility
+        (irregular cadence, tail data, histograms, non-divisible grid)
+        falls back to rangefn + aggregate() over the SAME selection —
+        never a second fetch (remote shard groups pull raw series over
+        the wire) or double-counted stats."""
+        if self.backend is None or plan.op not in ("sum", "count", "avg"):
+            return None
+        if plan.params:
+            return None
+        inner = plan.inner
+        if not isinstance(inner, lp.PeriodicSeriesWithWindowing):
+            return None
+        if inner.at_ms is not None or inner.func_args or \
+                inner.function not in ("rate", "increase", "delta"):
+            return None
+        raw = inner.raw
+        if not isinstance(raw, lp.RawSeriesPlan):
+            return None
+        fetch_start = inner.start_ms - inner.window_ms - inner.offset_ms
+        fetch_end = (inner.end_ms - inner.offset_ms if inner.offset_ms
+                     else inner.end_ms)
+        series = select_raw_series(
+            self.shards, raw.filters, fetch_start, fetch_end, raw.column,
+            self.stats, full=True, limits=self.limits)
+        params = RangeParams(inner.start_ms, inner.step_ms, inner.end_ms)
+        res = None
+        if series and not any(s.values.ndim == 2 for s in series):
+            keys = [dict(s.labels) for s in series]
+            gids, gkeys = _group_keys(keys, tuple(plan.by),
+                                      tuple(plan.without))
+            res = self.backend.fused_groupsum(
+                series, inner.function, params.steps, inner.window_ms,
+                inner.offset_ms, gids, len(gkeys))
+        if res is not None:
+            sums, cnts = res                       # [T, G]
+            cnt = cnts.T.astype(np.float64)        # [G, T]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if plan.op == "sum":
+                    out = sums.T.astype(np.float64)
+                elif plan.op == "count":
+                    out = cnt.copy()
+                else:
+                    out = sums.T.astype(np.float64) / cnt
+            out = np.where(cnt == 0, np.nan, out)
+            return GridResult(params.steps, gkeys, out)
+        # general path over the already-selected series
+        grid = None
+        if self.backend is not None:
+            grid = self.backend.periodic_samples(
+                series, params, inner.function, inner.window_ms, (),
+                inner.offset_ms)
+        if grid is None:
+            grid = periodic_samples(
+                clip_series(series, fetch_start, fetch_end), params,
+                inner.function, inner.window_ms, (), inner.offset_ms)
+        return aggregate(grid, plan.op, (), tuple(plan.by),
+                         tuple(plan.without))
+
     def _periodic(self, raw: lp.RawSeriesPlan, start_ms, step_ms, end_ms,
                   function, window_ms, func_args, offset_ms) -> GridResult:
         fetch_start = start_ms - window_ms - offset_ms
@@ -1069,14 +1137,37 @@ class QueryEngine:
 
     def _subquery(self, plan: lp.SubqueryWithWindowing) -> GridResult:
         """func(expr[w:s]): evaluate inner on the subquery grid, then window
-        over the inner steps (SubqueryWithWindowing semantics)."""
-        inner_start = plan.start_ms - plan.window_ms
-        sub = lp_replace_range(plan.inner, inner_start, plan.sub_step_ms,
-                               plan.end_ms)
-        inner = self._eval(sub)
+        over the inner steps (SubqueryWithWindowing semantics). With @ the
+        subquery grid is pinned to at_ms and every outer step carries the
+        pinned value (LogicalPlan.scala:349, ast/SubqueryUtils)."""
         steps = RangeParams(plan.start_ms, plan.step_ms, plan.end_ms).steps
+        if plan.at_ms is not None:
+            pin_end = plan.at_ms
+            inner_start = pin_end - plan.window_ms - plan.offset_ms
+            sub = lp_replace_range(plan.inner, inner_start,
+                                   plan.sub_step_ms,
+                                   pin_end - plan.offset_ms)
+            inner = self._eval(sub)
+            wend = np.array([pin_end - plan.offset_ms], dtype=np.int64)
+            wstart = wend - plan.window_ms
+            one = self._subquery_windows(plan, inner,
+                                         np.array([pin_end]), wstart, wend)
+            values = np.repeat(one.values, steps.size, axis=1)
+            return GridResult(steps, one.keys, values)
+        # the offset shifts which inner times the outer windows read:
+        # the inner grid must cover [start - offset - window, end - offset]
+        inner_start = plan.start_ms - plan.window_ms - plan.offset_ms
+        inner_end = (plan.end_ms - plan.offset_ms if plan.offset_ms
+                     else plan.end_ms)
+        sub = lp_replace_range(plan.inner, inner_start, plan.sub_step_ms,
+                               inner_end)
+        inner = self._eval(sub)
         wend = steps - plan.offset_ms
         wstart = wend - plan.window_ms
+        return self._subquery_windows(plan, inner, steps, wstart, wend)
+
+    def _subquery_windows(self, plan, inner, steps, wstart, wend
+                          ) -> GridResult:
         fn = rf.RANGE_FUNCTIONS.get(plan.function)
         if fn is None:
             raise QueryError(f"unknown range function {plan.function}")

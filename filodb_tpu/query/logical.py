@@ -63,6 +63,9 @@ class SubqueryWithWindowing:
     end_ms: int
     func_args: Tuple[float, ...] = ()
     offset_ms: int = 0
+    # @-pinned evaluation time (LogicalPlan.scala:349): the subquery grid
+    # ends at at_ms and every outer step carries the same pinned value
+    at_ms: Optional[int] = None
 
 
 @dataclass(frozen=True)

@@ -99,11 +99,15 @@ class QueryStats:
     series_scanned: int = 0
     samples_scanned: int = 0
     result_bytes: int = 0
+    # partial-result notes surfaced in the Prometheus response's
+    # `warnings` array (e.g. a shard still bootstrapping on its adopter)
+    warnings: list = field(default_factory=list)
 
     def add(self, other: "QueryStats") -> None:
         self.series_scanned += other.series_scanned
         self.samples_scanned += other.samples_scanned
         self.result_bytes += other.result_bytes
+        self.warnings.extend(other.warnings)
 
 
 class QueryError(Exception):

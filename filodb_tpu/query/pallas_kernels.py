@@ -24,7 +24,7 @@ callers must guard that the whole query span fits in int31 (~24.8 days).
 from __future__ import annotations
 
 import functools
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -65,78 +65,131 @@ def combine3(c: jnp.ndarray) -> jnp.ndarray:
 # as ONE pass over the stride-permuted tiles. XLA's best arrangement of
 # the same computation (slices -> epilogue -> one-hot matmul) pays ~2.5x
 # the HBM traffic materializing the [T, S] rate intermediate and
-# re-reading it on the MXU; here the 4 boundary row-blocks per step-tile
-# are DMA'd HBM->VMEM (double-buffered), the f32 extrapolation epilogue
+# re-reading it on the MXU; here the boundary row-blocks per step-tile
+# are DMA'd HBM->VMEM (double-buffered, prefetched across the sequential
+# program grid), the f32 extrapolation epilogue
 # (rangefn/RateFunctions.scala:23-79 semantics) runs in VMEM, and only
-# the [T, G] group sums + counts ever leave the chip. Values ride the
-# exact 3xf32 split (53 <= 24*3 mantissa bits), so boundary deltas keep
-# f64 precision without f64 ALU ops.
+# the [T, G] group sums + counts ever leave the chip.
+#
+# Values ride a per-series 2xint32 FIXED-POINT channel: at pack time each
+# series is rebased to its in-tile midpoint and scaled by a per-series
+# power of two so the full in-tile value range spans 61 bits split as
+# hi*2^31 + lo. Boundary deltas are computed as exact int32 subtractions
+# (dh, dl) and only the final f32 recombine dh*2^(31-s) + dl*2^-s rounds
+# — relative to the DELTA, not the absolute counter value — so the error
+# is 2^-23|delta| + span*2^-53: the same noise floor as the reference's
+# f64 path (RateFunctions.scala computes v2-v1 in f64), at 8 bytes per
+# value instead of 16 and with native i32 VPU ops instead of f64
+# emulation.
+#
+# Traffic shape: the dispatcher only takes grids where the window is a
+# whole number of steps ((kc0-kl0) % st == 0), which puts the
+# window-end family (kc0) and window-start family (kl0) in the SAME
+# stride-residue plane, dspan = (kc0-kl0)/st rows apart — one merged DMA
+# of TT+dspan rows serves both, and all views are STATIC slices of one
+# rolled block. The jitter fallback families (kc0-1 / kl0+1) are elided
+# entirely (hi_mode/lo_mode) when the query grid's phase relative to the
+# scrape ticks clears the tile's max jitter: then "is the boundary
+# sample inside the window" has the same answer for every series and
+# every step, statically.
 # ---------------------------------------------------------------------------
 
 _GS_TT = 128           # query steps per tile (sublane dim of compute)
 _GS_SS = 512           # series per tile (lane dim)
 _GS_AL = 8             # sublane alignment Mosaic requires of HBM slices
 
+# boundary-family modes (static per compiled kernel)
+GS_BOTH = 0            # jitter straddles the grid phase: select per element
+GS_CUR = 1             # the nominal slot is always inside the window
+GS_ALT = 2             # the nominal slot is always outside: use kc0-1/kl0+1
 
-def _groupsum_kernel(func: str, st: int, n_ttiles: int,
-                     params_ref, v_ref, oh_ref,
+_GS_DSPAN_MAX = 48     # dispatcher cap on window/step (merged-stream rows)
+
+
+def _gs_mlen(st: int, dspan: int) -> int:
+    lead = 1 if st == 1 else 0
+    return _GS_TT + _GS_AL + (-(-(dspan + lead) // _GS_AL)) * _GS_AL
+
+
+def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
+                     lo_mode: int, exact_branch: bool, n_ttiles: int,
+                     mlen: int,
+                     params_ref, v_ref, base_ref, oh_ref,
                      sum_ref, cnt_ref, v_scr, sems):
-    """Grid: (n_s,). params (SMEM, i32):
-    [kc0, kp0, kl0, kn0, w0e_rel, window, step, counts_base, T].
-    """
+    """Grid: (n_s,) sequential. params (SMEM, i32):
+    [kl0, w0e_rel, window, step, T]."""
     si = pl.program_id(0)
-    kstarts = [params_ref[0], params_ref[1], params_ref[2], params_ref[3]]
-    w0e_rel = params_ref[4]
-    window = params_ref[5]
-    step = params_ref[6]
-    counts_base = params_ref[7]
-    T = params_ref[8]
+    n_s = pl.num_programs(0)
+    kl0 = params_ref[0]
+    w0e_rel = params_ref[1]
+    window = params_ref[2]
+    step = params_ref[3]
+    T = params_ref[4]
+    kc0 = kl0 + dspan * st
+    lead = 1 if st == 1 else 0
+    # st == 1 puts every slot in the single residue plane, so the
+    # fallback families live INSIDE the merged block (lead covers kc0-1
+    # when dspan == 0); otherwise they are their own streams.
+    need1 = hi_mode != GS_CUR and st != 1
+    need3 = lo_mode != GS_CUR and st != 1
+    idx1 = 1
+    idx3 = 1 + (1 if need1 else 0)
+    i_kl = lead
+    i_kc = lead + dspan
+    i_f1 = dspan + lead - 1          # st == 1 only (kc0 - 1)
+    i_f3 = lead + 1                  # st == 1 only (kl0 + 1)
 
-    def fam_g(f, ti):
-        """(aligned DMA start, in-block row offset) for family f, tile ti.
-        HBM slices on the tiled G dim must start at a sublane-tile
-        multiple, so the DMA reads _GS_AL extra rows and the compute
-        phase shifts by `off` inside VMEM."""
-        kf = kstarts[f]
-        g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
-        g8 = pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
-        return g8, g - g8
-
-    def dmas(slot, ti):
+    def dmas(si_, slot, ti):
         out = []
-        for f in range(4):
-            kf = kstarts[f]
-            r = jax.lax.rem(kf, jnp.int32(st))
-            # the permuted G axis is padded past every tail tile
-            # (t_perm_tiled), so the block stays in bounds; dead rows
-            # are masked out of every contribution below via `live`.
-            # ONE copy per family: timestamps (bitcast f32) + h/m/l
-            # value planes ride a single CONTIGUOUS HBM read —
-            # consecutive G rows of a (s-tile, residue) plane are
-            # adjacent in memory.
-            g8, _ = fam_g(f, ti)
+        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * _GS_TT - lead
+        g8m = pl.multiple_of((g_m // _GS_AL) * _GS_AL, _GS_AL)
+        # the permuted G axis is padded past every tail tile
+        # (t_perm_tiled), so blocks stay in bounds; dead rows are masked
+        # out via `live`. ONE copy per stream: ts + hi + lo planes ride
+        # a single contiguous HBM read (consecutive G rows of a
+        # (s-tile, residue) plane are adjacent in memory).
+        out.append(pltpu.make_async_copy(
+            v_ref.at[si_, jax.lax.rem(kl0, jnp.int32(st)),
+                     pl.ds(g8m, mlen), :],
+            v_scr.at[slot, 0], sems.at[slot, 0]))
+        for need, idx, kf in ((need1, idx1, kc0 - 1),
+                              (need3, idx3, kl0 + 1)):
+            if not need:
+                continue
+            g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
+            g8 = pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
             out.append(pltpu.make_async_copy(
-                v_ref.at[si, r, pl.ds(g8, _GS_TT + _GS_AL), :],
-                v_scr.at[slot, f], sems.at[slot, f]))
+                v_ref.at[si_, jax.lax.rem(kf, jnp.int32(st)),
+                         pl.ds(g8, _GS_TT + _GS_AL), :],
+                v_scr.at[slot, idx, pl.ds(0, _GS_TT + _GS_AL)],
+                sems.at[slot, idx]))
         return out
 
     @pl.when(si == 0)
     def _():
         sum_ref[:] = jnp.zeros_like(sum_ref)
         cnt_ref[:] = jnp.zeros_like(cnt_ref)
-
-    for d in dmas(0, 0):
-        d.start()
+        for d in dmas(0, 0, 0):
+            d.start()
 
     def t_loop(ti, _):
-        slot = jax.lax.rem(ti, 2)
-        nxt = jax.lax.rem(ti + 1, 2)
+        gti = si * n_ttiles + ti
+        slot = jax.lax.rem(gti, 2)
+        nxt = jax.lax.rem(gti + 1, 2)
 
+        # prefetch the next tile — crossing into the next program's
+        # first tile at tile boundaries, so the DMA engine never idles
+        # between sequential grid programs
         @pl.when(ti + 1 < n_ttiles)
         def _():
-            for d in dmas(nxt, ti + 1):
+            for d in dmas(si, nxt, ti + 1):
                 d.start()
-        for d in dmas(slot, ti):
+
+        @pl.when((ti + 1 == n_ttiles) & (si + 1 < n_s))
+        def _():
+            for d in dmas(si + 1, nxt, 0):
+                d.start()
+        for d in dmas(si, slot, ti):
             d.wait()
 
         gt = ti * _GS_TT + jax.lax.broadcasted_iota(
@@ -144,65 +197,110 @@ def _groupsum_kernel(func: str, st: int, n_ttiles: int,
         live = gt < T
         wend_r = w0e_rel + gt * step
         wstart_r = wend_r - window
-        offs = [fam_g(f, ti)[1] for f in range(4)]
 
-        def shifted(full, f):
-            """Drop the first `offs[f]` alignment rows of a loaded
-            [TT+AL, SS] block -> [TT, SS] via dynamic sublane rotate
-            (plain dynamic_slice on vectors has no Mosaic lowering, and
-            NEGATIVE dynamic roll shifts mis-lower — rotate left by
-            `len - off` instead)."""
-            return pltpu.roll(full, shift=(_GS_TT + _GS_AL) - offs[f],
+        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * _GS_TT - lead
+        g8m = pl.multiple_of((g_m // _GS_AL) * _GS_AL, _GS_AL)
+        offm = g_m - g8m
+        # ONE dynamic roll; every family view is a STATIC slice of it
+        # (plain dynamic_slice on vectors has no Mosaic lowering, and
+        # NEGATIVE dynamic roll shifts mis-lower — rotate left by
+        # `len - off` instead). Row i of R is permuted-G row g_m + i.
+        R = pltpu.roll(v_scr[slot, 0], shift=mlen - offm, axis=0)
+
+        def view(row0):
+            return R[row0:row0 + _GS_TT]
+
+        def fam_view(idx, kf):
+            g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
+            off = g - pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
+            full = v_scr[slot, idx, :_GS_TT + _GS_AL]
+            return pltpu.roll(full, shift=(_GS_TT + _GS_AL) - off,
                               axis=0)[:_GS_TT]
 
-        vs = [shifted(v_scr[slot, f], f) for f in range(4)]
+        def planes(v):
+            return (v[:, :_GS_SS], v[:, _GS_SS:2 * _GS_SS],
+                    v[:, 2 * _GS_SS:3 * _GS_SS])
 
-        def tsch(f):
-            return vs[f][:, :_GS_SS]
+        ts_kc, hi_kc, lo_kc = planes(view(i_kc))
+        ts_kl, hi_kl, lo_kl = planes(view(i_kl))
+        if hi_mode != GS_CUR:
+            ts_kp, hi_kp, lo_kp = planes(
+                view(i_f1) if st == 1 else fam_view(idx1, kc0 - 1))
+        if lo_mode != GS_CUR:
+            ts_kn, hi_kn, lo_kn = planes(
+                view(i_f3) if st == 1 else fam_view(idx3, kl0 + 1))
 
-        ts_kc = tsch(0)
-        ts_kp = tsch(1)
-        ts_kcl = tsch(2)
-        ts_kn = tsch(3)
-        over = ts_kc > wend_r
-        under = ts_kcl < wstart_r
-        counts = (counts_base - over.astype(jnp.int32)
-                  - under.astype(jnp.int32))
-        use1 = ~over                                       # ts_kc <= wend
-        useb = ~under
-        t2 = jnp.where(use1, ts_kc, ts_kp)
-        t1 = jnp.where(useb, ts_kcl, ts_kn)
+        if hi_mode == GS_BOTH:
+            over = ts_kc > wend_r
+            overc = over.astype(jnp.int32)
+            t2 = jnp.where(over, ts_kp, ts_kc)
+            h2 = jnp.where(over, hi_kp, hi_kc)
+            l2 = jnp.where(over, lo_kp, lo_kc)
+        elif hi_mode == GS_CUR:
+            overc = jnp.int32(0)
+            t2, h2, l2 = ts_kc, hi_kc, lo_kc
+        else:
+            overc = jnp.int32(1)
+            t2, h2, l2 = ts_kp, hi_kp, lo_kp
+        if lo_mode == GS_BOTH:
+            under = ts_kl < wstart_r
+            underc = under.astype(jnp.int32)
+            t1 = jnp.where(under, ts_kn, ts_kl)
+            h1 = jnp.where(under, hi_kn, hi_kl)
+            l1 = jnp.where(under, lo_kn, lo_kl)
+        elif lo_mode == GS_CUR:
+            underc = jnp.int32(0)
+            t1, h1, l1 = ts_kl, hi_kl, lo_kl
+        else:
+            underc = jnp.int32(1)
+            t1, h1, l1 = ts_kn, hi_kn, lo_kn
 
-        def vch(f, c):
-            """h/m/l plane c of family f (packed after the ts plane)."""
-            return jax.lax.bitcast_convert_type(
-                vs[f][:, (c + 1) * _GS_SS:(c + 2) * _GS_SS], jnp.float32)
-
-        h2 = jnp.where(use1, vch(0, 0), vch(1, 0))
-        m2 = jnp.where(use1, vch(0, 1), vch(1, 1))
-        l2 = jnp.where(use1, vch(0, 2), vch(1, 2))
-        h1 = jnp.where(useb, vch(2, 0), vch(3, 0))
-        m1 = jnp.where(useb, vch(2, 1), vch(3, 1))
-        l1 = jnp.where(useb, vch(2, 2), vch(3, 2))
-        # exact-split delta: each per-channel difference is (near-)exact,
-        # and the sum telescopes to the f64 difference (see split3)
-        delta = (h2 - h1) + (m2 - m1) + (l2 - l1)
-        sampled = (t2 - t1).astype(jnp.float32) * 1e-3
-        dstart = (t1 - wstart_r).astype(jnp.float32) * 1e-3
-        dend = (wend_r - t2).astype(jnp.float32) * 1e-3
+        counts = (dspan * st + 1) - overc - underc
+        # exact integer boundary deltas; the f32 recombine rounds
+        # relative to the delta (see module comment)
+        dh = (h2 - h1).astype(jnp.float32)
+        dl = (l2 - l1).astype(jnp.float32)
+        c1 = base_ref[1:2, :]                              # 2^(31-s)
+        c2 = base_ref[2:3, :]                              # 2^-s
+        delta = dh * c1 + dl * c2
+        sampled_i = t2 - t1
+        dstart_i = t1 - wstart_r
+        dend_i = wend_r - t2
+        sampled = sampled_i.astype(jnp.float32) * 1e-3
+        dstart = dstart_i.astype(jnp.float32) * 1e-3
+        dend = dend_i.astype(jnp.float32) * 1e-3
         counts_f = counts.astype(jnp.float32)
         avg = sampled / (counts_f - 1.0)
+        th = avg * 1.1
+        # the "gap < 1.1 * avg interval" extrapolation branches: every
+        # input is integer ms, so when 10*counts*window can't overflow
+        # i32 the branch is decided EXACTLY as 10*(cnt-1)*gap <=
+        # 11*sampled (<=, not <: f64 rounds 1.1 upward, so the
+        # reference's f64 compare takes the extrapolate side on exact
+        # ties — knife-edge windows otherwise flip between the f32
+        # kernel and the f64 oracle)
+        if exact_branch:
+            cm1 = counts - 1
+            s11 = 11 * sampled_i
+            use_ds = (10 * cm1) * dstart_i <= s11
+            use_de = (10 * cm1) * dend_i <= s11
+        else:
+            use_ds = dstart < th
+            use_de = dend < th
         if func != "delta":
-            v1f = h1 + (m1 + l1)
+            v1f = (h1.astype(jnp.float32) * c1
+                   + l1.astype(jnp.float32) * c2) + base_ref[0:1, :]
             dzero = jnp.where(
                 (delta > 0) & (v1f >= 0),
                 sampled * (v1f / jnp.where(delta == 0, jnp.nan, delta)),
                 jnp.inf)
-            dstart = jnp.minimum(dstart, dzero)
-        th = avg * 1.1
+            zlt = dzero < dstart
+            dstart = jnp.where(zlt, dzero, dstart)
+            # boolean select via mask algebra (Mosaic has no i1 select)
+            use_ds = (zlt & (dzero < th)) | (~zlt & use_ds)
         extrap = sampled \
-            + jnp.where(dstart < th, dstart, avg * 0.5) \
-            + jnp.where(dend < th, dend, avg * 0.5)
+            + jnp.where(use_ds, dstart, avg * 0.5) \
+            + jnp.where(use_de, dend, avg * 0.5)
         factor = extrap / sampled
         if func == "rate":
             factor = factor / (window.astype(jnp.float32) * 1e-3)
@@ -224,38 +322,51 @@ def _groupsum_kernel(func: str, st: int, n_ttiles: int,
     jax.lax.fori_loop(0, n_ttiles, t_loop, None)
 
 
-def counter_groupsum(func: str, st: int, v_p, onehot,
-                     kc0: int, kl0: int, w0e_rel: int, window: int,
-                     step: int, nsteps: int,
-                     interpret: bool = False):
+def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
+                     lo_mode: int, v_p, base, onehot,
+                     kl0, w0e_rel, window: int, step: int, nsteps: int,
+                     interpret: bool = False,
+                     exact_branch: Optional[bool] = None):
     """sum by(group) of rate/increase/delta over stride-permuted dense
     tiles -> (sums f32 [T, G], counts f32 [T, G]; sum is only meaningful
     where count > 0).
 
-    v_p: the packed kernel channel [n_s, st, G_perm, 4*_GS_SS] i32 —
-    plane 0 = int32 relative timestamps, planes 1-3 = the exact 3xf32
-    split BITCAST to i32 (int lanes are inert in data movement; i32
-    timestamps bitcast to f32 would be flush-to-zero denormals) of the
-    (counter-corrected) value channel
-    (AlignedTiles.t_perm_split_tiled). onehot: [n_s * _GS_SS, G] f32
+    v_p: the packed kernel channel [n_s, st, G_perm, 3*_GS_SS] i32 —
+    plane 0 = int32 relative timestamps, planes 1-2 = the per-series
+    fixed-point hi/lo split of the (counter-corrected) value channel
+    (AlignedTiles.t_perm_fixed_tiled). base: [n_s, 8, _GS_SS] f32 — row
+    0 = per-series rebase midpoint (f32), row 1 = 2^(31-s), row 2 =
+    2^-s (AlignedTiles.t_fixed_base). onehot: [n_s * _GS_SS, G] f32
     group membership (pad series with all-zero one-hot rows).
-    Preconditions (the tilestore dispatcher checks them): regular grid
-    step == st*dt entirely interior to the tile, dense tiles, span fits
-    int32 ms."""
+
+    Static dispatch contract (the tilestore dispatcher checks it):
+    regular grid with step == st*dt entirely interior to the tile,
+    dense tiles, span fits int32 ms, kc0 - kl0 == dspan * st with
+    kc0/kl0 the per-query boundary slots, and hi_mode/lo_mode sound for
+    the tile's jitter bound (GS_CUR/GS_ALT only when the grid phase
+    clears the max |ts - tick|)."""
     n_s = v_p.shape[0]
     G = onehot.shape[1]
     assert onehot.shape[0] == n_s * _GS_SS, (onehot.shape, n_s)
     T_pad = -(-nsteps // _GS_TT) * _GS_TT
     n_ttiles = T_pad // _GS_TT
+    mlen = _gs_mlen(st, dspan)
+    if exact_branch is None:
+        # integer extrapolation-branch products must fit i32
+        exact_branch = 11 * int(window) * (dspan * st + 1) < 2 ** 31
+    need1 = hi_mode != GS_CUR and st != 1
+    need3 = lo_mode != GS_CUR and st != 1
+    nstreams = 1 + (1 if need1 else 0) + (1 if need3 else 0)
     params = jnp.asarray(
         jnp.stack([jnp.asarray(v, jnp.int32) for v in (
-            kc0, kc0 - 1, kl0, kl0 + 1, w0e_rel, window, step,
-            kc0 + 1 - kl0, nsteps)]))
+            kl0, w0e_rel, window, step, nsteps)]))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_s,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, 8, _GS_SS), lambda si, p: (si, 0, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((_GS_SS, G), lambda si, p: (si, 0),
                          memory_space=pltpu.VMEM),
         ],
@@ -266,20 +377,30 @@ def counter_groupsum(func: str, st: int, v_p, onehot,
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, 4, _GS_TT + _GS_AL, 4 * _GS_SS), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 4)),
+            pltpu.VMEM((2, nstreams, mlen, 3 * _GS_SS), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, nstreams)),
         ],
     )
-    with jax.enable_x64(False):
-        sums, cnts = pl.pallas_call(
-            functools.partial(_groupsum_kernel, func, st, n_ttiles),
+
+    def body(params, v_p, base, onehot, *, _k=functools.partial(
+            _groupsum_kernel, func, st, dspan, hi_mode, lo_mode,
+            bool(exact_branch), n_ttiles, mlen)):
+        def kern(params_ref, v_ref, base_ref, oh_ref,
+                 sum_ref, cnt_ref, v_scr, sems):
+            _k(params_ref, v_ref, base_ref[0], oh_ref,
+               sum_ref, cnt_ref, v_scr, sems)
+        return pl.pallas_call(
+            kern,
             grid_spec=grid_spec,
             out_shape=(
                 jax.ShapeDtypeStruct((T_pad, G), jnp.float32),
                 jax.ShapeDtypeStruct((T_pad, G), jnp.float32),
             ),
             interpret=interpret,
-        )(params, v_p, onehot)
+        )(params, v_p, base, onehot)
+
+    with jax.enable_x64(False):
+        sums, cnts = body(params, v_p, base, onehot)
     return sums[:nsteps], cnts[:nsteps]
 
 

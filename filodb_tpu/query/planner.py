@@ -569,9 +569,24 @@ class QueryPlanner:
                 else sorted(self._by_num)
         down: List[int] = []
         if self.mapper is not None:
+            from filodb_tpu.parallel.shardmapper import ShardStatus
             ok = set(self.mapper.active_shards(nums))
             down = [n for n in nums if n not in ok]
             nums = [n for n in nums if n in ok]
+            # flag, don't hide: a peer-owned shard still in RECOVERY
+            # (its adopter is bootstrapping/replaying) serves what it
+            # has — the response carries a partial-result warning
+            for n in nums:
+                if n not in self._by_num and \
+                        self.mapper.status(n) is ShardStatus.RECOVERY:
+                    self.stats.warnings.append(
+                        f"shard {n} is recovering on "
+                        f"{self.mapper.node_of(n)}; results may be "
+                        f"partial")
+            if down and not self.buddies:
+                self.stats.warnings.append(
+                    "shards " + ",".join(map(str, down))
+                    + " are down with no replica; results are partial")
         local = [self._by_num[n] for n in nums if n in self._by_num]
         if down and self.buddies:
             # failover: serve a down shard from the buddy replica of its

@@ -84,6 +84,7 @@ class AlignedTiles:
         self._tbf: Dict[str, jnp.ndarray] = {}
         self._tps: Dict[str, jnp.ndarray] = {}
         self._tperm: Dict[Tuple[str, int], jnp.ndarray] = {}
+        self._jitter = None
         self._jl = None
         self._jf = None
         self._dense = bool(np.asarray(valid).all())
@@ -331,11 +332,15 @@ class AlignedTiles:
         key = (name + "#tiled", st)
         c = self._tperm.get(key)
         if c is None:
-            from filodb_tpu.query.pallas_kernels import (_GS_AL, _GS_SS,
-                                                         _GS_TT)
+            from filodb_tpu.query.pallas_kernels import (_GS_AL,
+                                                         _GS_DSPAN_MAX,
+                                                         _GS_SS, _GS_TT)
             N = src.shape[0]
             S = src.shape[1]
-            G = -(-N // st) + _GS_TT + _GS_AL
+            # pad the permuted G axis past every tail tile: the kernel's
+            # merged kc/kl stream reads up to dspan (<= _GS_DSPAN_MAX)
+            # + alignment rows past the last window-end row
+            G = -(-N // st) + _GS_TT + 2 * _GS_AL + _GS_DSPAN_MAX
             padn = G * st - N
             if padn:
                 src = jnp.concatenate(
@@ -351,36 +356,105 @@ class AlignedTiles:
             self._tperm[key] = c
         return c
 
-    def t_perm_split_tiled(self, vch: str, st: int) -> jnp.ndarray:
-        """The Pallas group-sum kernel's packed channel: s-tile-major
-        stride-permuted [n_s, st, G, 4*SS] f32 where plane 0 is the
-        int32 relative timestamp BITCAST to f32 and planes 1-3 are the
-        exact 3xf32 split of the value channel ([..., SS:2SS]=h,
-        [2SS:3SS]=m, [3SS:4SS]=l). One kernel DMA per boundary family
-        fetches timestamps + values as a single contiguous read (see
-        t_perm_tiled / split3)."""
-        key = (vch + "#split_tiled", st)
+    def _fixed_channels(self, vch: str):
+        """Per-series 61-bit fixed-point encoding of a value channel for
+        the group-sum kernel: each series is rebased to its in-tile
+        midpoint and scaled by a per-series power of two 2^s chosen so
+        |v - mid| * 2^s <= 2^60, then split as hi*2^31 + lo with lo in
+        [0, 2^31). Integer boundary subtractions in the kernel are then
+        EXACT; only the final f32 recombine rounds, relative to the
+        delta — the same noise floor as the reference's f64 arithmetic
+        (rangefn/RateFunctions.scala:23).
+
+        Returns (hi [N,S] i32, lo [N,S] i32, mid_f32 [S], s [S] i32) or
+        None when the channel has non-finite values."""
+        key = (vch, "#fixed")
         c = self._tperm.get(key)
         if c is None:
             v = self.t_channel(vch)                      # [N, S] f64
-            h = v.astype(jnp.float32)
-            r = v - h.astype(jnp.float64)
-            m = r.astype(jnp.float32)
-            l = (r - m.astype(jnp.float64)).astype(jnp.float32)
-            # the packed array is INT32: timestamps ride directly and the
-            # f32 value planes ride bitcast — int lanes are inert, while
-            # i32 timestamps bitcast to f32 would be denormals that TPU
-            # data movement can flush to zero
-            parts = [self.t_perm_tiled(
-                f"{vch}#ts{i}", st,
-                ch if i == 0 else jax.lax.bitcast_convert_type(
-                    ch, jnp.int32))
-                for i, ch in enumerate((self.t_tsr_i32(), h, m, l))]
+            vmax = jnp.max(v, axis=0)
+            vmin = jnp.min(v, axis=0)
+            if not bool(jnp.isfinite(vmax).all()
+                        & jnp.isfinite(vmin).all()):
+                self._tperm[key] = (None,)
+                return None
+            mid = (vmax + vmin) * 0.5
+            # host-side scale selection ([S]-sized; f64 frexp has no TPU
+            # lowering): span2 <= 2^e with frexp's m in [0.5, 1)
+            span2 = np.maximum(np.asarray(vmax - vmin) * 0.5, 2.0 ** -130)
+            _, e = np.frexp(span2)
+            s_np = np.clip(60 - e, -96, 126).astype(np.int32)
+            s = jnp.asarray(s_np)
+            scale = jnp.asarray(np.ldexp(1.0, s_np))
+            fixed = jnp.rint(
+                (v - mid[None, :]) * scale[None, :]
+            ).astype(jnp.int64)
+            hi64 = fixed >> 31
+            lo = (fixed - (hi64 << 31)).astype(jnp.int32)
+            c = (hi64.astype(jnp.int32), lo,
+                 mid.astype(jnp.float32), s)
+            self._tperm[key] = c
+        return None if c == (None,) else c
+
+    def t_perm_fixed_tiled(self, vch: str, st: int) -> jnp.ndarray:
+        """The Pallas group-sum kernel's packed channel: s-tile-major
+        stride-permuted [n_s, st, G, 3*SS] i32 where plane 0 is the
+        int32 relative timestamp and planes 1-2 are the per-series
+        fixed-point hi/lo split of the value channel (_fixed_channels).
+        One kernel DMA per boundary stream fetches timestamps + values
+        as a single contiguous read (see t_perm_tiled)."""
+        key = (vch + "#fixed_tiled", st)
+        c = self._tperm.get(key)
+        if c is None:
+            fx = self._fixed_channels(vch)
+            assert fx is not None, "dispatcher must gate on finiteness"
+            hi, lo = fx[0], fx[1]
+            parts = [self.t_perm_tiled(f"{vch}#fx{i}", st, ch)
+                     for i, ch in enumerate(
+                         (self.t_tsr_i32(), hi, lo))]
             c = jnp.asarray(jnp.concatenate(parts, axis=3))
-            for i in range(4):
-                self._tperm.pop((f"{vch}#ts{i}" + "#tiled", st), None)
+            for i in range(3):
+                self._tperm.pop((f"{vch}#fx{i}" + "#tiled", st), None)
             self._tperm[key] = c
         return c
+
+    def t_fixed_base(self, vch: str) -> jnp.ndarray:
+        """[n_s, 8, SS] f32 companion of t_perm_fixed_tiled: row 0 =
+        per-series rebase midpoint (f32, used only by the counter-zero
+        extrapolation limiter), row 1 = 2^(31-s), row 2 = 2^-s."""
+        key = (vch + "#fixed_base", 0)
+        c = self._tperm.get(key)
+        if c is None:
+            from filodb_tpu.query.pallas_kernels import _GS_SS
+            fx = self._fixed_channels(vch)
+            assert fx is not None
+            mid, s = fx[2], fx[3]
+            c1 = jnp.ldexp(jnp.float32(1.0), 31 - s)
+            c2 = jnp.ldexp(jnp.float32(1.0), -s)
+            S = mid.shape[0]
+            S_pad = -(-S // _GS_SS) * _GS_SS
+            rows = jnp.zeros((3, S_pad), jnp.float32)
+            rows = rows.at[0, :S].set(mid).at[1, :S].set(c1)
+            rows = rows.at[2, :S].set(c2)
+            rows = jnp.pad(rows, ((0, 5), (0, 0)))
+            c = jnp.asarray(
+                rows.reshape(8, S_pad // _GS_SS, _GS_SS)
+                .transpose(1, 0, 2))
+            self._tperm[key] = c
+        return c
+
+    def jitter_ms(self) -> float:
+        """Max |ts - nominal slot tick| over valid slots: the bound the
+        group-sum dispatcher uses to elide jitter-fallback families
+        when the query grid phase statically clears it."""
+        if self._jitter is None:
+            ticks = (self.base_ms
+                     + jnp.arange(self.num_slots, dtype=jnp.float64)
+                     * self.dt_ms)
+            d = jnp.where(self.valid,
+                          jnp.abs(self.ts - ticks[None, :]), 0.0)
+            self._jitter = float(jnp.max(d))
+        return self._jitter
 
 
 _SENT_LO = -(2 ** 31)           # "no sample at or before this slot"
@@ -1061,16 +1135,42 @@ def groupsum_counters(tiles: AlignedTiles, func: str, steps: np.ndarray,
         return None
     st, k_c0, k_l0 = el
     from filodb_tpu.query import pallas_kernels as pk
+    # merged-stream contract: the window must span a whole number of
+    # steps so the kc/kl families share a stride-residue plane
+    d = k_c0 - k_l0
+    if d % st != 0 or not (0 <= d // st <= pk._GS_DSPAN_MAX):
+        return None
+    dspan = d // st
+    if st == 1 and k_l0 < 1:
+        return None              # the merged block reads one lead row
     S = len(tiles.keys)
-    S_pad = -(-S // pk._GS_SS) * pk._GS_SS
+    G = int(np.asarray(onehot).shape[1])
+    T_pad = -(-nsteps // pk._GS_TT) * pk._GS_TT
+    if T_pad * G * 8 > 4 << 20:
+        return None              # [T, G] accumulators must fit VMEM
     vch = "cv" if func in ("rate", "increase") else "v"
-    v_p = tiles.t_perm_split_tiled(vch, st)
+    if tiles._fixed_channels(vch) is None:
+        return None              # non-finite values: exact f64 fallback
+    # static jitter-phase elision: when the grid phase clears the
+    # tile's max |ts - tick|, the boundary-sample choice is the same
+    # for every series and step, and the fallback family is never read
+    dt = tiles.dt_ms
+    J = tiles.jitter_ms()
+    phase_e = (w0e - tiles.base_ms) - k_c0 * dt
+    phase_s = k_l0 * dt - (w0s - tiles.base_ms)
+    hi_mode = (pk.GS_CUR if phase_e >= J else
+               pk.GS_ALT if phase_e < -J else pk.GS_BOTH)
+    lo_mode = (pk.GS_CUR if phase_s >= J else
+               pk.GS_ALT if phase_s < -J else pk.GS_BOTH)
+    S_pad = -(-S // pk._GS_SS) * pk._GS_SS
+    v_p = tiles.t_perm_fixed_tiled(vch, st)
+    base = tiles.t_fixed_base(vch)
     onehot = jnp.asarray(onehot, jnp.float32)
     if S_pad != S:
         onehot = jnp.pad(onehot, ((0, S_pad - S), (0, 0)))
     return pk.counter_groupsum(
-        func, st, v_p, onehot,
-        k_c0, k_l0, w0e - tiles.base_ms, window_ms, step, nsteps,
+        func, st, dspan, hi_mode, lo_mode, v_p, base, onehot,
+        k_l0, w0e - tiles.base_ms, window_ms, step, nsteps,
         interpret=interpret)
 
 

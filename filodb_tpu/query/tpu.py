@@ -398,6 +398,7 @@ class TpuBackend:
         self._tile_lock = threading.Lock()
         self.tile_builds = 0    # observability: device tile (re)builds
         self.tile_hits = 0      # observability: cache hits
+        self.fused_aggs = 0     # observability: fused group-sum queries
 
     def periodic_samples(self, series: Sequence[RawSeries],
                          params: RangeParams, function: str, window_ms: int,
@@ -564,6 +565,43 @@ class TpuBackend:
                                             step_ms, window_ms, offset_ms,
                                             func_args)
         return full
+
+    def fused_groupsum(self, series, func: str, steps: np.ndarray,
+                       window_ms: int, offset_ms: int,
+                       gids: np.ndarray, G: int):
+        """`sum/avg/count by (g)` of rate/increase/delta fused on device:
+        the Pallas group-sum kernel consumes the cached aligned tiles and
+        only [T, G] group sums + counts leave the chip — the [S, T] rate
+        intermediate is never materialized (the reference pays this as
+        per-shard AggrOverRangeVectors map-reduce over row iterators,
+        exec/aggregator/*.scala). Returns (sums, cnts) as [T, G] numpy
+        or None when ineligible (caller falls back to the general
+        rangefn + aggregate path)."""
+        from filodb_tpu.query import tilestore as tst
+
+        if func not in ("rate", "increase", "delta") or not len(series):
+            return None
+        tiles, idx, _, _ = self._tile_entry(series)
+        if tiles is None or len(idx) != len(series):
+            return None
+        # every window must resolve on the immutable prefix: fused
+        # results can't splice a host-side tail scan per group
+        for s in series:
+            cl = self._prefix_len(s)
+            if cl < s.ts.size and steps.size and \
+                    int(steps[-1] - offset_ms) >= int(s.ts[cl]):
+                return None
+        onehot = np.zeros((len(series), G), np.float32)
+        onehot[np.arange(len(series)), np.asarray(gids)[np.asarray(idx)]] \
+            = 1.0
+        import jax
+        res = tst.groupsum_counters(
+            tiles, func, steps, window_ms, onehot, offset_ms,
+            interpret=jax.default_backend() == "cpu")
+        if res is None:
+            return None
+        self.fused_aggs += 1
+        return np.asarray(res[0]), np.asarray(res[1])
 
     @staticmethod
     def _window_sample_bound(series, window_ms: int, n_cap: int) -> int:

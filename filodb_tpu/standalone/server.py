@@ -274,6 +274,9 @@ class FiloServer:
                                   else None),
                 on_node_down=self._on_node_down,
                 on_node_up=self._on_node_up).start()
+            # the health body advertises this node's down-view (quorum
+            # input) and served-shard statuses (gossip) to its peers
+            self.http.detector = self.detector
         if streaming:
             self._start_ingestion()
         return self
@@ -349,10 +352,12 @@ class FiloServer:
                 self.mapper.update(sh, ShardStatus.RECOVERY, owner)
                 mine.append(sh)
             else:
-                # another survivor adopts it; mark ACTIVE optimistically
-                # (no cross-node status gossip — the failure detector
-                # health-checks that owner and flips DOWN if it dies)
-                self.mapper.update(sh, ShardStatus.ACTIVE, owner)
+                # another survivor adopts it; hold RECOVERY until the
+                # adopter's health body advertises it (the detector's
+                # status gossip promotes it ACTIVE) — queries routed
+                # meanwhile carry a partial-result warning instead of
+                # silently missing the bootstrapping shard
+                self.mapper.update(sh, ShardStatus.RECOVERY, owner)
 
         def adopt_all():
             # off the detector's poll thread: ColumnStore bootstrap can
@@ -384,10 +389,14 @@ class FiloServer:
             mine = self._adopted.pop(node, [])
         # hand every reassigned shard back to its original owner (each
         # node recomputes identically; the returned node re-bootstraps
-        # from the shared store + streams on its own startup)
+        # from the shared store + streams on its own startup). Held in
+        # RECOVERY until the owner's health body advertises the shard —
+        # the detector's status gossip promotes it, so queries carry a
+        # partial-result warning instead of silently missing data while
+        # the owner is still bootstrapping
         for sh in self._original_shards.get(node, []):
             self.mapper.assign(sh, node)
-            self.mapper.update(sh, ShardStatus.ACTIVE, node)
+            self.mapper.update(sh, ShardStatus.RECOVERY, node)
 
         def release_all():
             # off the poll thread: driver stops join + flush (the same

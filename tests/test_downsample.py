@@ -55,6 +55,143 @@ def test_gauge_kernel_matches_oracle():
         assert np.all(np.isnan(sums[i][~has]))
 
 
+@pytest.mark.parametrize("phase_off", [0, 8_500])
+def test_gauge_regular_fast_path_matches_oracle(phase_off):
+    """Reshape fast path == oracle on jittered regular-cadence tiles,
+    for both boundary-crossing directions (grid phase below/above
+    dt/2)."""
+    rng = np.random.default_rng(11)
+    S, N, DT = 6, 700, 10_000
+    t0 = T0_MS + phase_off
+    jit = rng.integers(-2000, 2000, (S, N))
+    ts = t0 + np.arange(N, dtype=np.int64)[None, :] * DT + jit
+    vals = rng.normal(50, 20, (S, N))
+    lens = np.full(S, N, dtype=np.int32)
+    base = (int(ts.min()) // RES) * RES
+    nperiods = int((ts.max() - base) // RES) + 1
+    res = kernels.downsample_gauge_fast(ts, vals, lens, base, RES,
+                                        nperiods)
+    assert res is not None
+    sums, cnts, mins, maxs, last_v, last_ts = [np.asarray(a) for a in res]
+    crossings = 0
+    for i in range(S):
+        o = kernels.downsample_gauge_oracle(ts[i], vals[i], base, RES,
+                                            nperiods)
+        has = o[1] > 0
+        np.testing.assert_allclose(sums[i][has], o[0][has], rtol=1e-12)
+        np.testing.assert_array_equal(cnts[i], o[1])
+        np.testing.assert_allclose(mins[i][has], o[2][has])
+        np.testing.assert_allclose(maxs[i][has], o[3][has])
+        np.testing.assert_allclose(last_v[i][has], o[4][has])
+        np.testing.assert_array_equal(last_ts[i][has], o[5][has])
+        # prove jitter actually moved samples across period boundaries
+        naive = (np.arange(N) * DT + (t0 - base)) // RES
+        actual = (ts[i] - base) // RES
+        crossings += int((naive != actual).sum())
+    assert crossings > 0
+
+
+@pytest.mark.parametrize("phase_off", [-2_000, 3_000, 8_000])
+def test_gauge_regular_fast_path_wide_jitter(phase_off):
+    """Jitter close to dt/2 with phases that put the first/last ticks on
+    the wrong side of the base period boundary (the out-of-slice edge
+    tick must still be folded into its period)."""
+    rng = np.random.default_rng(29)
+    S, N, DT = 4, 500, 10_000
+    t0 = T0_MS + phase_off
+    jit = rng.integers(-4000, 4000, (S, N))
+    ts = t0 + np.arange(N, dtype=np.int64)[None, :] * DT + jit
+    vals = rng.normal(0, 30, (S, N))
+    lens = np.full(S, N, dtype=np.int32)
+    base = (int(ts.min()) // RES) * RES
+    nperiods = int((ts.max() - base) // RES) + 1
+    res = kernels.downsample_gauge_fast(ts, vals, lens, base, RES,
+                                        nperiods)
+    assert res is not None
+    got = [np.asarray(a) for a in res]
+    for i in range(S):
+        o = kernels.downsample_gauge_oracle(ts[i], vals[i], base, RES,
+                                            nperiods)
+        has = o[1] > 0
+        np.testing.assert_allclose(got[0][i][has], o[0][has], rtol=1e-12)
+        np.testing.assert_array_equal(got[1][i], o[1])
+        np.testing.assert_allclose(got[2][i][has], o[2][has])
+        np.testing.assert_allclose(got[3][i][has], o[3][has])
+        np.testing.assert_allclose(got[4][i][has], o[4][has])
+        np.testing.assert_array_equal(got[5][i][has], o[5][has])
+
+
+def test_gauge_regular_edge_tick_before_base():
+    """A first tick whose nominal time precedes the batch base but whose
+    jitter lands it inside period 0 must be counted (up-mode edge)."""
+    DT = 10_000
+    base = T0_MS
+    # nominal first tick 2s BEFORE base, jittered +3s into period 0
+    nominal = base - 2_000 + np.arange(40, dtype=np.int64) * DT
+    ts = nominal.copy()
+    ts[0] += 3_000
+    vals = np.arange(40, dtype=np.float64)
+    S_ts = ts[None, :]
+    res = kernels.downsample_gauge_fast(
+        S_ts, vals[None, :], np.array([40], np.int32), base, RES,
+        int((ts.max() - base) // RES) + 1)
+    assert res is not None
+    o = kernels.downsample_gauge_oracle(ts, vals, base, RES,
+                                        int((ts.max() - base) // RES) + 1)
+    np.testing.assert_array_equal(np.asarray(res[1])[0], o[1])
+    has = o[1] > 0
+    np.testing.assert_allclose(np.asarray(res[0])[0][has], o[0][has])
+
+
+def test_gauge_regular_fast_path_gates():
+    S, N, DT = 2, 600, 10_000
+    lens = np.full(S, N, dtype=np.int32)
+    ts = T0_MS + np.arange(N, dtype=np.int64)[None, :] * DT \
+        + np.zeros((S, 1), np.int64)
+    vals = np.zeros((S, N))
+    # irregular cadence -> None
+    ts_bad = ts.copy()
+    ts_bad[0, N // 2:] += 57_000
+    assert kernels.downsample_gauge_fast(ts_bad, vals, lens, T0_MS, RES,
+                                         4) is None
+    # ragged rows -> None
+    lens2 = lens.copy()
+    lens2[1] = 100
+    assert kernels.downsample_gauge_fast(ts, vals, lens2, T0_MS, RES,
+                                         4) is None
+
+
+def test_cascade_aligned_matches_direct():
+    rng = np.random.default_rng(5)
+    S, N, DT = 4, 1500, 10_000
+    res1h = 3_600_000
+    ts = T0_MS + np.arange(N, dtype=np.int64)[None, :] * DT \
+        + rng.integers(-2000, 2000, (S, N))
+    vals = rng.normal(0, 5, (S, N))
+    lens = np.full(S, N, dtype=np.int32)
+    base5 = (int(ts.min()) // RES) * RES
+    base1h = (int(ts.min()) // res1h) * res1h
+    nper5 = int((ts.max() - base5) // RES) + 1
+    nper1h = int((ts.max() - base1h) // res1h) + 1
+    fine = kernels.downsample_gauge_fast(ts, vals, lens, base5, RES, nper5)
+    lead = (base5 - base1h) // RES
+    casc = [np.asarray(a) for a in kernels.cascade_gauge_aligned(
+        fine, res1h // RES, int(lead))]
+    for i in range(S):
+        o = kernels.downsample_gauge_oracle(ts[i], vals[i], base1h,
+                                            res1h, nper1h)
+        has = o[1] > 0
+        Q = casc[0].shape[1]
+        np.testing.assert_allclose(casc[0][i][:Q][has[:Q]],
+                                   o[0][has][:Q], rtol=1e-12)
+        np.testing.assert_array_equal(casc[1][i][:Q], o[1][:Q])
+        np.testing.assert_allclose(casc[2][i][:Q][has[:Q]], o[2][has][:Q])
+        np.testing.assert_allclose(casc[3][i][:Q][has[:Q]], o[3][has][:Q])
+        np.testing.assert_allclose(casc[4][i][:Q][has[:Q]], o[4][has][:Q])
+        np.testing.assert_array_equal(casc[5][i][:Q][has[:Q]],
+                                      o[5][has][:Q])
+
+
 def test_counter_emit_mask_keeps_period_lasts_and_peaks():
     ts = np.arange(1, 61, dtype=np.int64)[None, :] * 10_000 + T0_MS
     vals = np.cumsum(np.full(60, 5.0))

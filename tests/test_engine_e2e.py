@@ -199,6 +199,43 @@ def test_subquery_max_of_rate():
     np.testing.assert_allclose(res.values[0], 10.0, rtol=1e-9)
 
 
+def test_subquery_with_offset_covers_window():
+    """min/avg over an offset subquery must see the FULL window: the
+    inner grid extends to start - offset - window (a truncated inner
+    grid silently shrank early windows)."""
+    shard = make_shard()
+    ingest_gauges(shard, [({}, 0.0)], n_samples=300)
+    res = run(shard, "avg_over_time(cpu_usage[10m:1m] offset 20m)")
+    plain = run(shard, "avg_over_time(cpu_usage[10m:1m])",
+                start=T0 + 600 - 1200, step=60, end=T0 + 3000 - 1200)
+    np.testing.assert_allclose(res.values[0], plain.values[0], rtol=1e-9,
+                               equal_nan=True)
+
+
+def test_subquery_at_pinned():
+    """expr[w:s] @ t pins the subquery grid; every outer step carries the
+    pinned value (LogicalPlan.scala:349, ast/SubqueryUtils)."""
+    shard = make_shard()
+    # gauge rising by 1 per 10s: avg over a pinned 10m window is a fixed
+    # number regardless of the outer step
+    ingest_gauges(shard, [({}, 0.0)], n_samples=300)
+    pin = T0 + 2000
+    res = run(shard, f"avg_over_time(cpu_usage[10m:1m] @ {pin}.0)")
+    assert res.values.shape[1] > 1
+    assert np.allclose(res.values[0], res.values[0][0])
+    # oracle: unpinned instant evaluation at the pin time
+    one = run(shard, "avg_over_time(cpu_usage[10m:1m])",
+              start=pin, step=60, end=pin)
+    np.testing.assert_allclose(res.values[0][0], one.values[0][0],
+                               rtol=1e-9)
+    # @ end() == pinning to the query range end
+    res2 = run(shard, "avg_over_time(cpu_usage[10m:1m] @ end())")
+    one2 = run(shard, "avg_over_time(cpu_usage[10m:1m])",
+               start=T0 + 3000, step=60, end=T0 + 3000)
+    np.testing.assert_allclose(res2.values[0][0], one2.values[0][0],
+                               rtol=1e-9)
+
+
 def test_label_replace_e2e():
     shard = make_shard()
     ingest_gauges(shard, [({"host": "node-7"}, 0.0)])

@@ -1,0 +1,88 @@
+"""FailureDetector unit tests: status gossip from peers' health bodies,
+quorum-gated elastic reassignment, and recovery promotion — the
+Akka-cluster gossip-convergence analogue (FilodbCluster.scala), tested
+without sockets by stubbing the health probe."""
+
+import time
+
+from filodb_tpu.parallel.cluster import FailureDetector
+from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+
+
+def _mk(peers, shards_by_node, num_shards=8, grace=0.0, **kw):
+    mapper = ShardMapper(num_shards)
+    for node, shards in shards_by_node.items():
+        for sh in shards:
+            mapper.assign(sh, node)
+            mapper.update(sh, ShardStatus.ACTIVE, node)
+    fired = []
+    det = FailureDetector(
+        mapper, {p: f"http://{p}" for p in peers}, shards_by_node,
+        interval_s=0.01, threshold=1, timeout_s=0.1,
+        reassign_grace_s=grace,
+        on_node_down=fired.append, **kw)
+    return mapper, det, fired
+
+
+def test_status_gossip_promotes_recovering_shard():
+    """A shard held RECOVERY locally is promoted when its owner's
+    health body advertises it ACTIVE — and not before."""
+    mapper, det, _ = _mk(["b"], {"b": [3]})
+    mapper.update(3, ShardStatus.RECOVERY, "b")
+    bodies = {"b": {"shards": {}, "down_peers": []}}
+    det._probe = lambda url: bodies["b"]
+    det.poll_once()
+    assert mapper.status(3) is ShardStatus.RECOVERY   # not advertised yet
+    bodies["b"] = {"shards": {"3": "recovery"}, "down_peers": []}
+    det.poll_once()
+    assert mapper.status(3) is ShardStatus.RECOVERY
+    bodies["b"] = {"shards": {"3": "active"}, "down_peers": []}
+    det.poll_once()
+    assert mapper.status(3) is ShardStatus.ACTIVE
+
+
+def test_gossip_ignores_shards_owned_elsewhere():
+    """A peer advertising a shard the mapper assigns to another node
+    must not flip that shard's status (stale adopter)."""
+    mapper, det, _ = _mk(["b", "c"], {"b": [1], "c": [2]})
+    det._probe = lambda url: (
+        {"shards": {"2": "recovery"}, "down_peers": []}
+        if "b" in url else {"shards": {"2": "active"}, "down_peers": []})
+    det.poll_once()
+    assert mapper.status(2) is ShardStatus.ACTIVE
+
+
+def test_quorum_blocks_lone_suspicion():
+    """With other alive peers NOT sharing the down-view, reassignment
+    must not fire (a one-sided network partition would otherwise cause
+    dual ingest)."""
+    mapper, det, fired = _mk(["b", "c"], {"b": [1], "c": [2]})
+    det._probe = lambda url: (
+        None if "b" in url
+        else {"shards": {"2": "active"}, "down_peers": []})
+    for _ in range(3):
+        det.poll_once()
+        time.sleep(0.01)
+    assert det.is_down("b")
+    assert fired == []                     # c disagrees: no reassignment
+    assert mapper.status(1) is ShardStatus.DOWN   # still marked down
+
+
+def test_quorum_agreement_fires_reassignment():
+    mapper, det, fired = _mk(["b", "c"], {"b": [1], "c": [2]})
+    det._probe = lambda url: (
+        None if "b" in url
+        else {"shards": {"2": "active"}, "down_peers": ["b"]})
+    for _ in range(3):
+        det.poll_once()
+        time.sleep(0.01)
+    assert fired == ["b"]
+
+
+def test_two_node_cluster_fires_without_peers_to_consult():
+    mapper, det, fired = _mk(["b"], {"b": [1]})
+    det._probe = lambda url: None
+    for _ in range(3):
+        det.poll_once()
+        time.sleep(0.01)
+    assert fired == ["b"]

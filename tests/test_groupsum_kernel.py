@@ -51,6 +51,63 @@ def test_groupsum_matches_per_series_eval(func):
     np.testing.assert_allclose(sums, want_s, rtol=1e-5, atol=1e-7)
 
 
+def _want(tiles, func, steps, window, gid, G):
+    per = np.asarray(tst.evaluate_counters_t(tiles, func, steps, window))
+    ok = ~np.isnan(per)
+    want_s = np.stack([np.where(ok[:, gid == g], per[:, gid == g], 0)
+                       .sum(axis=1) for g in range(G)], 1)
+    want_c = np.stack([ok[:, gid == g].sum(axis=1)
+                       for g in range(G)], 1).astype(np.float32)
+    return want_s, want_c
+
+
+@pytest.mark.parametrize("phase", [3000, -3000])
+def test_groupsum_phase_elided_families(phase):
+    """Grid phases that clear the tile's jitter compile the CUR/ALT
+    static modes (no fallback-family stream); results must still match
+    the per-series evaluator exactly."""
+    S, G = 64, 4
+    rng = np.random.default_rng(11)
+    N = 288
+    ts = (BASE + np.arange(N)[None, :] * DT
+          + rng.uniform(-500, 500, (S, N)))          # small jitter
+    vals = np.cumsum(rng.uniform(0, 5, (S, N)), axis=1) + 1e12
+    tiles = tst.AlignedTiles([{} for _ in range(S)], BASE, DT,
+                             np.ones((S, N), bool), ts, vals)
+    assert tiles.jitter_ms() <= 500
+    steps = np.arange(BASE + 400_000 + phase, BASE + 2_400_000, 60_000,
+                      dtype=np.int64)
+    gid = np.arange(S) % G
+    onehot = np.zeros((S, G), np.float32)
+    onehot[np.arange(S), gid] = 1.0
+    res = tst.groupsum_counters(tiles, "rate", steps, 300_000, onehot,
+                                interpret=True)
+    assert res is not None
+    want_s, want_c = _want(tiles, "rate", steps, 300_000, gid, G)
+    np.testing.assert_array_equal(np.asarray(res[1]), want_c)
+    np.testing.assert_allclose(np.asarray(res[0]), want_s,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_groupsum_st1_single_stream():
+    """step == dt puts every boundary family inside the one merged
+    residue plane (single DMA stream per tile)."""
+    S, G = 48, 3
+    tiles = _tiles(S, 400)
+    steps = np.arange(BASE + 400_000, BASE + 2_000_000, 10_000,
+                      dtype=np.int64)
+    gid = np.arange(S) % G
+    onehot = np.zeros((S, G), np.float32)
+    onehot[np.arange(S), gid] = 1.0
+    res = tst.groupsum_counters(tiles, "increase", steps, 300_000,
+                                onehot, interpret=True)
+    assert res is not None
+    want_s, want_c = _want(tiles, "increase", steps, 300_000, gid, G)
+    np.testing.assert_array_equal(np.asarray(res[1]), want_c)
+    np.testing.assert_allclose(np.asarray(res[0]), want_s,
+                               rtol=1e-5, atol=1e-7)
+
+
 def test_groupsum_dispatcher_fallbacks():
     tiles = _tiles(16, 288)
     onehot = np.ones((16, 1), np.float32)
@@ -74,4 +131,27 @@ def test_groupsum_dispatcher_fallbacks():
     steps = np.arange(BASE + 400_000, BASE + 1_000_000, 60_000,
                       dtype=np.int64)
     assert tst.groupsum_counters(gappy, "rate", steps, 300_000,
+                                 onehot, interpret=True) is None
+    # window not a whole number of steps: merged kc/kl stream contract
+    steps = np.arange(BASE + 400_000, BASE + 1_000_000, 60_000,
+                      dtype=np.int64)
+    assert tst.groupsum_counters(tiles, "rate", steps, 290_000,
+                                 onehot, interpret=True) is None
+    # window/step beyond the merged-stream row cap
+    steps = np.arange(BASE + 900_000, BASE + 2_000_000, 10_000,
+                      dtype=np.int64)
+    assert tst.groupsum_counters(tiles, "rate", steps, 600_000,
+                                 onehot, interpret=True) is None
+    # non-finite values fall back to the exact f64 path
+    bad = _tiles(16, 288)
+    bad.vals = bad.vals.at[0, 5].set(np.inf) if hasattr(
+        bad.vals, "at") else bad.vals
+    import jax.numpy as jnp
+    bad.vals = jnp.asarray(np.where(
+        np.arange(288)[None, :] == 5, np.inf, np.asarray(bad.vals)))
+    bad._channels.clear()
+    bad._tch.clear()
+    steps = np.arange(BASE + 400_000, BASE + 1_000_000, 60_000,
+                      dtype=np.int64)
+    assert tst.groupsum_counters(bad, "rate", steps, 300_000,
                                  onehot, interpret=True) is None

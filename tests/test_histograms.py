@@ -290,7 +290,11 @@ def test_at_on_non_selector_rejected():
     with pytest.raises(ParseError, match="@"):
         parse_query_range("sum(rate(req_latency[5m])) @ 100", tsp)
     with pytest.raises(ParseError, match="@"):
-        parse_query_range("sum_over_time(req_latency[10m:1m] @ 100", tsp)
+        parse_query_range("(req_latency + req_latency) @ 100", tsp)
+    # @ on subqueries is supported (pinned grid)
+    plan = parse_query_range("sum_over_time(req_latency[10m:1m] @ 100)",
+                             tsp)
+    assert plan.at_ms == 100_000
 
 
 def test_drop_table_flows_to_raw_series():

@@ -132,4 +132,4 @@ def test_batched_ingest_chunk_rollover_sizes():
         shard.ingest(c)
     part = next(iter(shard.partitions.values()))
     assert [ch.num_rows for ch in part.chunks] == [100, 100, 100]
-    assert len(part._ts_buf) == 50
+    assert part._buf_rows == 50

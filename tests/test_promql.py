@@ -139,6 +139,23 @@ def test_subquery():
     assert plan.window_ms == 1_800_000
     assert plan.sub_step_ms == 60_000
     assert isinstance(plan.inner, lp.PeriodicSeriesWithWindowing)
+    assert plan.at_ms is None
+
+
+def test_subquery_at_pinning():
+    """expr[w:s] @ t and @ start()/end() (LogicalPlan.scala:349,
+    ast/SubqueryUtils)."""
+    plan = parse("max_over_time(rate(foo[5m])[30m:1m] @ 1700000000)")
+    assert isinstance(plan, lp.SubqueryWithWindowing)
+    assert plan.at_ms == 1_700_000_000_000
+    plan = parse("avg_over_time(foo[10m:] @ start())")
+    assert plan.at_ms == P.start_s * 1000
+    plan = parse("avg_over_time(foo[10m:] @ end() offset 5m)")
+    assert plan.at_ms == P.end_s * 1000
+    assert plan.offset_ms == 300_000
+    # selectors accept start()/end() too
+    plan = parse("rate(foo[5m] @ end())")
+    assert plan.at_ms == P.end_s * 1000
 
 
 def test_scalar_exprs():

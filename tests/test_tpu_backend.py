@@ -114,10 +114,26 @@ def test_engine_with_tpu_backend_e2e():
     plan = parse_query_range("sum(rate(reqs_total[5m]))",
                              TimeStepParams(t0 + 600, 60, t0 + 3000))
     oracle_res = QueryEngine([shard]).execute(plan)
-    tpu_res = QueryEngine([shard], backend=TpuBackend()).execute(plan)
+    backend = TpuBackend()
+    tpu_res = QueryEngine([shard], backend=backend).execute(plan)
     # rate rides the tilestore f32-hybrid path: ~3e-7 relative vs oracle
     np.testing.assert_allclose(tpu_res.values, oracle_res.values, rtol=1e-5,
                                equal_nan=True)
     # steady increase of 7*(s+1) per 10s across 6 series
     expected = sum(0.7 * (s + 1) for s in range(6))
     np.testing.assert_allclose(tpu_res.values[0], expected, rtol=1e-5)
+    # the whole sum(rate(...)) ran inside the fused Pallas group-sum
+    # kernel — no [S, T] per-series intermediate
+    assert backend.fused_aggs == 1
+
+    # grouped + avg/count variants ride the same fused path
+    for q in ("sum(rate(reqs_total[5m])) by (instance)",
+              "avg(rate(reqs_total[5m]))",
+              "count(rate(reqs_total[5m]))"):
+        plan = parse_query_range(q, TimeStepParams(t0 + 600, 60, t0 + 3000))
+        want = QueryEngine([shard]).execute(plan)
+        got = QueryEngine([shard], backend=backend).execute(plan)
+        assert [dict(k) for k in got.keys] == [dict(k) for k in want.keys]
+        np.testing.assert_allclose(got.values, want.values, rtol=1e-5,
+                                   equal_nan=True)
+    assert backend.fused_aggs == 4
