@@ -66,7 +66,8 @@ DT = 10_000
 WINDOW = 300_000
 STEP = 60_000
 N_GROUPS = 16
-K = 16              # chained shifted-grid queries
+K = 32              # chained shifted-grid queries (large enough that the
+#                     chain dwarfs the tunnel's host-sync floor)
 BASE = 1_600_000_000_000
 
 
@@ -263,6 +264,13 @@ def main():
     import bench_ingest
     ing = bench_ingest.measure()
     ds = bench_downsample.measure()     # full 1.07B-sample batch set
+    _mark("e2e latency-under-load sub-bench")
+    import bench_e2e
+    try:
+        e2e = bench_e2e.measure()       # gatling-analogue, own process
+    except Exception as e:              # regression guard, not a gate
+        e2e = {"value": None, "p95_ms": None, "qps": None,
+               "error": f"{type(e).__name__}: {e}"}
 
     print(json.dumps({
         "metric": "rate_sum_by_samples_scanned_per_sec",
@@ -278,6 +286,9 @@ def main():
         "ingest_encode_samples_per_s": ing["encode_samples_per_s"],
         "downsample_samples_per_s": ds["value"],
         "downsample_batch_samples": ds["total_samples"],
+        "e2e_p50_ms": e2e["value"],
+        "e2e_p95_ms": e2e["p95_ms"],
+        "e2e_qps": e2e["qps"],
     }))
 
 

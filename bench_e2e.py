@@ -56,7 +56,7 @@ def _get(port, path, **params):
         return json.loads(r.read())
 
 
-def main():
+def measure():
     tmp = tempfile.mkdtemp(prefix="filodb-e2e-")
     port, gw_port = _free_port(), _free_port()
     cfg = {
@@ -159,7 +159,7 @@ def main():
 
         lats_ms = np.asarray(lats) * 1000
         last = timings[-1] if timings else {}
-        print(json.dumps({
+        return {
             "metric": "e2e_query_p50_ms",
             "value": round(float(np.percentile(lats_ms, 50)), 2),
             "unit": "ms",
@@ -170,13 +170,17 @@ def main():
             "queries": len(lats),
             "live_ingest": True,
             "server_spans_last": last,
-        }))
+        }
     finally:
         proc.terminate()
         try:
             proc.wait(timeout=20)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def main():
+    print(json.dumps(measure()))
 
 
 if __name__ == "__main__":

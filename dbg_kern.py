@@ -1,0 +1,85 @@
+"""Dev harness: per-config group-sum kernel timings on the big tiles."""
+import sys
+import time
+
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+import bench as B  # noqa: E402
+from filodb_tpu.query import pallas_kernels as pk  # noqa: E402
+from filodb_tpu.query import tilestore as tst  # noqa: E402
+
+S, N, DT, WINDOW, STEP, NG = B.S, B.N, B.DT, B.WINDOW, B.STEP, B.N_GROUPS
+BASE = B.BASE
+
+
+def mark(m):
+    print(f"[{time.strftime('%H:%M:%S')}] {m}", flush=True)
+
+
+def main():
+    ts, vals = B._gen_device()
+    tiles = tst.AlignedTiles([{} for _ in range(S)], BASE, DT,
+                             np.ones((S, N), bool), ts, vals)
+    del ts, vals
+    ST = STEP // DT
+    DSPAN = WINDOW // STEP
+    cv_t = tiles.t_channel("cv")
+    cv_t.block_until_ready()
+    tiles._channels.clear()
+    tiles.vals = None
+    v_p = tiles.t_perm_fixed_tiled("cv", ST)
+    base = tiles.t_fixed_base("cv")
+    v_p.block_until_ready()
+    del cv_t
+    tiles.ts = tiles.valid = None
+    tiles._tch.clear()
+    tiles._tperm.clear()
+    T = (N * DT - WINDOW - 300_000) // STEP
+    onehot = jnp.zeros((S, NG), jnp.float32).at[
+        jnp.arange(S), jnp.arange(S) // (S // NG)].set(1.0)
+    noop = jax.jit(lambda x: jnp.zeros((NG, T), jnp.float32) + x)
+    np.asarray(noop(jnp.float32(0)))
+
+    K = 32
+
+    def chain(hi, lo):
+        @jax.jit
+        def many(shift, v_p, base, oh):
+            acc = jnp.zeros((T, NG), jnp.float32)
+            kl0s = jnp.arange(K, dtype=jnp.int32) + shift
+            w0es = (jnp.arange(K, dtype=jnp.int32) + shift) * DT + WINDOW
+
+            def body(a, p):
+                kl0, w0e = p
+                s_, c_ = pk.counter_groupsum(
+                    "rate", ST, DSPAN, hi, lo, v_p, base, oh,
+                    kl0, w0e, WINDOW, STEP, T)
+                return a + jnp.where(c_ > 0, s_, 0.0), jnp.int32(0)
+            acc, _ = jax.lax.scan(body, acc, (kl0s, w0es))
+            return acc
+        return many
+
+    for name, hi, lo in (("BOTH/BOTH", pk.GS_BOTH, pk.GS_BOTH),
+                         ("CUR/ALT", pk.GS_CUR, pk.GS_ALT)):
+        many = chain(hi, lo)
+        mark(f"compile {name}")
+        np.asarray(many(jnp.int32(0), v_p, base, onehot))
+        mark(f"compiled {name}")
+        best = []
+        for i in range(4):
+            fl = min(B._timed(lambda: np.asarray(noop(jnp.float32(j))))
+                     for j in range(2))
+            t = B._timed(lambda: np.asarray(
+                many(jnp.int32(1 + i), v_p, base, onehot)))
+            best.append(max(t - min(fl, t * 0.5), t * 0.05) / K)
+        ms = np.median(best) * 1000
+        mark(f"{name}: {ms:.2f} ms/query")
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,8 @@ class TimeSeriesPartition:
                 if self._hist_scheme is None:
                     self._hist_scheme = scheme
                 buf.append(np.asarray(counts, dtype=np.int64))
+            elif col.col_type == ColumnType.STRING:
+                buf.append("" if v is None else str(v))
             else:
                 buf.append(np.asarray([v], dtype=np.float64))
         self._buf_rows += 1
@@ -167,7 +169,9 @@ class TimeSeriesPartition:
             return n
         hist_cols = [i for i, c in enumerate(self.schema.data_columns)
                      if c.col_type == ColumnType.HISTOGRAM]
-        col_arrays = [None if ci in hist_cols
+        str_cols = [i for i, c in enumerate(self.schema.data_columns)
+                    if c.col_type == ColumnType.STRING]
+        col_arrays = [None if ci in hist_cols or ci in str_cols
                       else np.asarray(col_values[ci], dtype=np.float64)
                       for ci in range(len(self._col_bufs))]
         pos = 0
@@ -185,6 +189,11 @@ class TimeSeriesPartition:
                         if self._hist_scheme is None:
                             self._hist_scheme = scheme
                         buf.append(np.asarray(counts, dtype=np.int64))
+                elif ci in str_cols:
+                    vals = col_values[ci]
+                    for k in range(pos, pos + take):
+                        v = vals[k]
+                        buf.append("" if v is None else str(v))
                 else:
                     buf.append(np.array(col_arrays[ci][pos:pos + take]))
             self._buf_rows += take
@@ -221,6 +230,8 @@ class TimeSeriesPartition:
                 rows = np.stack(buf) if buf else np.zeros((0, 0), np.int64)
                 vecs.append(bh.encode_histograms(
                     self._hist_scheme, rows, counter=col.counter))
+            elif col.col_type == ColumnType.STRING:
+                vecs.append(bv.encode_strings(buf))
             else:
                 vecs.append(bv.encode_doubles(
                     np.concatenate(buf) if buf
@@ -261,7 +272,7 @@ class TimeSeriesPartition:
         snaps, counts = [], []
         for buf, col in zip(self._col_bufs, self.schema.data_columns):
             b = list(buf)
-            if col.col_type == ColumnType.HISTOGRAM:
+            if col.col_type in (ColumnType.HISTOGRAM, ColumnType.STRING):
                 snaps.append(b)
                 counts.append(len(b))
             else:
@@ -313,9 +324,13 @@ class TimeSeriesPartition:
                     entry[5] = off + vals.shape[0]
                     if vals.shape[0]:
                         entry[6] = vals[-1]
+                    entry[2].append(vals)
+                elif col.col_type == ColumnType.STRING:
+                    vals = bv.decode_strings(c.vectors[col_index])
+                    entry[2].append(vals)
                 else:
                     vals = bv.decode_doubles(c.vectors[col_index])
-                entry[2].append(vals)
+                    entry[2].append(vals)
             entry[0] = n
             entry[3] = None
         if entry[3] is None:
@@ -329,6 +344,8 @@ class TimeSeriesPartition:
             else:
                 col_empty = (np.zeros((0, 0))
                              if col.col_type == ColumnType.HISTOGRAM
+                             else np.zeros(0, dtype=object)
+                             if col.col_type == ColumnType.STRING
                              else np.zeros(0))
                 cat = (np.zeros(0, dtype=np.int64), col_empty)
             # cache-backed arrays are shared with query results: freeze them
@@ -367,6 +384,8 @@ class TimeSeriesPartition:
             if cvals.ndim == 2 and tail.ndim == 2 \
                     and cvals.shape[1] != tail.shape[1] and cvals.size == 0:
                 cvals = np.zeros((0, tail.shape[1]))
+        elif col.col_type == ColumnType.STRING:
+            tail = np.asarray(buf_cols[col_index - 1], dtype=object)
         else:
             tail = np.asarray(buf_cols[col_index - 1], dtype=np.float64)
         mts = np.concatenate([cts, buf_ts])

@@ -1,0 +1,60 @@
+"""Per-tenant cardinality metering publisher.
+
+The reference runs TenantIngestionMetering
+(coordinator/src/main/scala/filodb.coordinator/TenantIngestionMetering.scala):
+a periodic task issuing TsCardinalities against every dataset and
+publishing the per-(_ws_, _ns_) series counts as metrics, so operators
+chart tenant growth without querying the cardinality API. Same shape
+here: a daemon thread snapshots the shard cardinality trackers at a
+fixed interval into gauges the /metrics exposition serves."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Mapping, Tuple
+
+
+class TenantMetering:
+    """Periodic depth-2 (workspace, namespace) cardinality snapshots."""
+
+    def __init__(self, trackers: Mapping[int, object],
+                 interval_s: float = 60.0, depth: int = 2):
+        self.trackers = trackers          # shard -> CardinalityTracker
+        self.interval_s = interval_s
+        self.depth = depth
+        # (ws, ns) -> (ts_count, active_ts_count); swapped atomically
+        self.latest: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+        self.snapshots = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def snapshot_once(self) -> None:
+        agg: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+        for tracker in list(self.trackers.values()):
+            for rec in tracker.scan((), self.depth):
+                if len(rec.prefix) != self.depth:
+                    continue
+                t, a = agg.get(rec.prefix, (0, 0))
+                agg[rec.prefix] = (t + rec.ts_count,
+                                   a + rec.active_ts_count)
+        self.latest = agg                 # atomic rebind for readers
+        self.snapshots += 1
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.snapshot_once()
+            except Exception:
+                pass                      # keep the metering loop alive
+
+    def start(self) -> "TenantMetering":
+        self.snapshot_once()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tenant-metering")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -222,7 +222,7 @@ class RecordContainer:
         cols = []
         from filodb_tpu.core.schemas import ColumnType  # cycle-free late
         for col, vals in zip(self.schema.data_columns, self.columns):
-            if col.col_type == ColumnType.HISTOGRAM:
+            if col.col_type in (ColumnType.HISTOGRAM, ColumnType.STRING):
                 cols.append(vals)
             else:
                 cols.append(np.asarray(vals, dtype=np.float64))

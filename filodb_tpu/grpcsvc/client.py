@@ -86,7 +86,10 @@ class GrpcRemoteExec:
     def __init__(self, query: str, start_ms: int, step_ms: int,
                  end_ms: int, node_id: str, addr: str, dataset: str,
                  timeout_s: float = 60.0, stats=None,
-                 local_only: bool = True):
+                 local_only: bool = True, plan_wire: bytes = b""):
+        # structural plan tree (query.planwire); when present the peer
+        # executes it directly and `query` is only a debug label
+        self.plan_wire = plan_wire
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -102,7 +105,8 @@ class GrpcRemoteExec:
         from filodb_tpu.query.model import GridResult, RangeParams
         payload = wire.encode_exec_request(
             self.dataset, self.query, self.start_ms, self.step_ms,
-            self.end_ms, local_only=self.local_only)
+            self.end_ms, local_only=self.local_only,
+            plan_wire=self.plan_wire)
         buf = _call(self.addr, "Exec", payload, self.timeout_s,
                     self.node_id)
         steps, keys, values, hv, les, stats, error = \

@@ -102,7 +102,12 @@ class GrpcQueryServer:
             if engine is None:
                 return wire.encode_exec_response(
                     None, error=f"dataset {req['dataset']} not set up")
-            if req["step_ms"] > 0:
+            if req["plan_wire"]:
+                # structural plan tree: no PromQL printer/parser in the
+                # loop (exec_plan.proto capability)
+                from filodb_tpu.query.planwire import plan_from_wire
+                plan = plan_from_wire(req["plan_wire"])
+            elif req["step_ms"] > 0:
                 plan = parse_query_range(
                     req["query"],
                     TimeStepParams(req["start_ms"] // 1000,

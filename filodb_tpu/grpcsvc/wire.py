@@ -253,15 +253,22 @@ def decode_raw_response(buf: bytes):
 
 def encode_exec_request(dataset: str, query: str, start_ms: int,
                         step_ms: int, end_ms: int,
-                        local_only: bool = True) -> bytes:
-    return (_ld(1, dataset.encode()) + _ld(2, query.encode())
-            + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
-            + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
+                        local_only: bool = True,
+                        plan_wire: bytes = b"") -> bytes:
+    """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
+    the reference's exec_plan.proto capability; the printed query text
+    stays alongside for debuggability and older peers."""
+    out = (_ld(1, dataset.encode()) + _ld(2, query.encode())
+           + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
+           + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
+    if plan_wire:
+        out += _ld(8, plan_wire)
+    return out
 
 
 def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
-           "end_ms": 0, "local_only": True}
+           "end_ms": 0, "local_only": True, "plan_wire": b""}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -275,6 +282,8 @@ def decode_exec_request(buf: bytes) -> Dict:
             req["end_ms"] = _signed(v)
         elif f == 6:
             req["local_only"] = bool(v)
+        elif f == 8:
+            req["plan_wire"] = v
     return req
 
 

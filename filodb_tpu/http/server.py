@@ -174,8 +174,17 @@ class FiloHttpServer:
                         self.shard_mapper.status(n).value
             down = (sorted(self.detector.down_peers())
                     if self.detector is not None else [])
-            return 200, {"status": "healthy", "shards": shards_adv,
-                         "down_peers": down}
+            body = {"status": "healthy", "shards": shards_adv,
+                    "down_peers": down}
+            gs = getattr(self, "grpc_server", None)
+            if gs is not None:
+                # advertise the data-plane port; peers combine it with
+                # this node's known host (gossip discovery for
+                # ephemeral-port deployments)
+                body["grpc_port"] = gs.port
+            # introspection: which peers this node has discovered
+            body["grpc_peers"] = dict(self.grpc_peers)
+            return 200, body
         if path == "/metrics":
             return 200, self._metrics_text()
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
@@ -428,6 +437,15 @@ class FiloHttpServer:
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
+        meter = getattr(self, "tenant_metering", None)
+        if meter is not None:
+            # periodic per-tenant cardinality gauges
+            # (TenantIngestionMetering.scala publishes these on a timer)
+            for prefix, (total, active) in sorted(meter.latest.items()):
+                labels = {"_ws_": prefix[0] if len(prefix) > 0 else "",
+                          "_ns_": prefix[1] if len(prefix) > 1 else ""}
+                emit("tenant_time_series_total", labels, total)
+                emit("tenant_time_series_active", labels, active)
         return "\n".join(lines) + "\n"
 
     def _cardinality(self, ds: str, qs: Dict, local: bool = False):

@@ -1,0 +1,10 @@
+"""Operational batch jobs (the reference's spark-jobs family beyond the
+chunk downsampler): downsample-index migration, cross-store chunk
+repair/copy, and cardinality busting."""
+
+from filodb_tpu.jobs.index_migration import DSIndexJob, DSIndexStats
+from filodb_tpu.jobs.chunk_copier import ChunkCopier, ChunkCopierStats
+from filodb_tpu.jobs.cardbuster import CardBuster, CardBusterStats
+
+__all__ = ["DSIndexJob", "DSIndexStats", "ChunkCopier",
+           "ChunkCopierStats", "CardBuster", "CardBusterStats"]

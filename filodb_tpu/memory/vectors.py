@@ -43,6 +43,9 @@ K_DOUBLE_XOR = 3      # pack_doubles payload
 K_DOUBLE_COUNTER = 4  # pack_doubles payload, counter semantics (apply correction)
 K_LONG_AS_DOUBLE = 5  # delta-delta longs holding integral doubles
 K_DOUBLE_CONST = 6    # f64 value repeated num_rows times
+K_STR_CONST = 7       # one UTF-8 value repeated num_rows times
+K_STR_DICT = 8        # dict UTF-8 + multi-width (8/16-bit) index stream
+K_STR_UTF8 = 9        # u32 offsets (n+1) + UTF-8 blob
 
 _HDR = struct.Struct("<BI")
 
@@ -140,6 +143,83 @@ def decode_doubles(buf: bytes) -> np.ndarray:
     if kind == K_LONG_AS_DOUBLE:
         return decode_longs(buf[off + 1 :]).astype(np.float64)
     raise ValueError(f"not a double vector kind: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# String vectors (UTF8Vector.scala / DictUTF8Vector.scala /
+# ConstVector.scala): const when every row repeats one value,
+# dict-encoded with MULTI-WIDTH integer indices (IntBinaryVector.scala's
+# 8/16-bit packing applied to the code stream) at low cardinality, raw
+# offsets + blob otherwise.
+# ---------------------------------------------------------------------------
+
+def encode_strings(values) -> bytes:
+    """Encode a string column chunk. None encodes as ""."""
+    vals = ["" if v is None else str(v) for v in values]
+    n = len(vals)
+    if n and all(v == vals[0] for v in vals):
+        b = vals[0].encode()
+        if len(b) <= 0xFFFFFFFF:
+            return (_header(K_STR_CONST, n)
+                    + struct.pack("<I", len(b)) + b)
+    uniq = list(dict.fromkeys(vals))
+    # dict only pays when values repeat (DictUTF8Vector's shouldMakeDict
+    # samples cardinality before committing to the dict form)
+    if n and len(uniq) <= 0x10000 and 2 * len(uniq) <= n \
+            and all(len(v.encode()) <= 0xFFFF for v in uniq):
+        idx_of = {v: i for i, v in enumerate(uniq)}
+        width = 1 if len(uniq) <= 0x100 else 2
+        out = bytearray(_header(K_STR_DICT, n))
+        out += struct.pack("<IB", len(uniq), width)
+        for v in uniq:
+            vb = v.encode()
+            out += struct.pack("<H", len(vb))
+            out += vb
+        dt = np.uint8 if width == 1 else np.uint16
+        out += np.asarray([idx_of[v] for v in vals], dtype=dt).tobytes()
+        return bytes(out)
+    blob = bytearray()
+    offs = np.zeros(n + 1, dtype=np.uint32)
+    for i, v in enumerate(vals):
+        blob += v.encode()
+        offs[i + 1] = len(blob)
+    return (bytes(_header(K_STR_UTF8, n)) + offs.tobytes() + bytes(blob))
+
+
+def decode_strings(buf: bytes) -> np.ndarray:
+    """Decode to a numpy object array of str."""
+    kind, n = parse_header(buf)
+    off = _HDR.size
+    if kind == K_STR_CONST:
+        (blen,) = struct.unpack_from("<I", buf, off)
+        v = buf[off + 4:off + 4 + blen].decode()
+        out = np.empty(n, dtype=object)
+        out[:] = v
+        return out
+    if kind == K_STR_DICT:
+        nuniq, width = struct.unpack_from("<IB", buf, off)
+        off += 5
+        uniq = []
+        for _ in range(nuniq):
+            (vlen,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            uniq.append(buf[off:off + vlen].decode())
+            off += vlen
+        dt = np.uint8 if width == 1 else np.uint16
+        idx = np.frombuffer(buf, dtype=dt, count=n, offset=off)
+        out = np.empty(n, dtype=object)
+        for i, code in enumerate(idx):
+            out[i] = uniq[code]
+        return out
+    if kind == K_STR_UTF8:
+        offs = np.frombuffer(buf, dtype=np.uint32, count=n + 1,
+                             offset=off)
+        base = off + 4 * (n + 1)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = buf[base + offs[i]:base + offs[i + 1]].decode()
+        return out
+    raise ValueError(f"not a string vector kind: {kind}")
 
 
 def is_counter_vector(buf: bytes) -> bool:

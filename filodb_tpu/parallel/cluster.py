@@ -21,6 +21,7 @@ import base64
 import json
 import threading
 import time
+import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
@@ -267,9 +268,15 @@ class FailureDetector:
                  interval_s: float = 0.5, threshold: int = 3,
                  timeout_s: float = 2.0,
                  reassign_grace_s: Optional[float] = None,
-                 on_node_down=None, on_node_up=None):
+                 on_node_down=None, on_node_up=None,
+                 grpc_peer_sink: Optional[Dict[str, str]] = None):
         self.mapper = mapper
         self.peers = dict(peers)
+        # mutable {node -> "host:port"} the poller fills from peers'
+        # advertised gRPC ports (shared with the planner's grpc_peers,
+        # so leaf dispatch upgrades to the binary data plane as soon as
+        # a peer is discovered)
+        self.grpc_peer_sink = grpc_peer_sink
         self.shards_by_node = {k: list(v) for k, v in
                                shards_by_node.items()}
         self.interval_s = interval_s
@@ -361,6 +368,12 @@ class FailureDetector:
                 self._peer_shards[node] = adv
                 self._peer_down_view[node] = set(
                     body.get("down_peers") or ())
+                gport = body.get("grpc_port")
+                if gport and self.grpc_peer_sink is not None \
+                        and node not in self.grpc_peer_sink:
+                    host = urllib.parse.urlparse(url).hostname \
+                        or "127.0.0.1"
+                    self.grpc_peer_sink[node] = f"{host}:{int(gport)}"
                 if self._down[node]:
                     self._down[node] = False
                     self._down_since.pop(node, None)

@@ -94,7 +94,11 @@ def combine3(c: jnp.ndarray) -> jnp.ndarray:
 # every step, statically.
 # ---------------------------------------------------------------------------
 
-_GS_TT = 128           # query steps per tile (sublane dim of compute)
+_GS_TT = 256           # query steps per tile (sublane dim of compute):
+#                        256 halves the sequential-grid iteration count
+#                        vs 128 — the loop is scalar-core/DMA-issue
+#                        bound, so fewer, larger tiles win
+
 _GS_SS = 512           # series per tile (lane dim)
 _GS_AL = 8             # sublane alignment Mosaic requires of HBM slices
 
@@ -104,6 +108,10 @@ GS_CUR = 1             # the nominal slot is always inside the window
 GS_ALT = 2             # the nominal slot is always outside: use kc0-1/kl0+1
 
 _GS_DSPAN_MAX = 48     # dispatcher cap on window/step (merged-stream rows)
+
+import os as _os  # noqa: E402
+_GS_ABLATE = frozenset(
+    (_os.environ.get("GS_ABLATE") or "").split(","))  # dev-only knob
 
 
 def _gs_mlen(st: int, dspan: int) -> int:
@@ -205,15 +213,20 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         # (plain dynamic_slice on vectors has no Mosaic lowering, and
         # NEGATIVE dynamic roll shifts mis-lower — rotate left by
         # `len - off` instead). Row i of R is permuted-G row g_m + i.
-        R = pltpu.roll(v_scr[slot, 0], shift=mlen - offm, axis=0)
+        if "noroll" in _GS_ABLATE:
+            R = v_scr[slot, 0]
+        else:
+            R = pltpu.roll(v_scr[slot, 0], shift=mlen - offm, axis=0)
 
         def view(row0):
             return R[row0:row0 + _GS_TT]
 
         def fam_view(idx, kf):
+            full = v_scr[slot, idx, :_GS_TT + _GS_AL]
+            if "noroll" in _GS_ABLATE:
+                return full[:_GS_TT]
             g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
             off = g - pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
-            full = v_scr[slot, idx, :_GS_TT + _GS_AL]
             return pltpu.roll(full, shift=(_GS_TT + _GS_AL) - off,
                               axis=0)[:_GS_TT]
 
@@ -304,20 +317,29 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         factor = extrap / sampled
         if func == "rate":
             factor = factor / (window.astype(jnp.float32) * 1e-3)
-        out = delta * factor
+        if "noepi" in _GS_ABLATE:
+            out = delta
+        else:
+            out = delta * factor
         ok = live & (counts >= 2) & ~jnp.isnan(out)
         local = jnp.where(ok, out, jnp.float32(0.0))
         okf = jnp.where(ok, jnp.float32(1.0), jnp.float32(0.0))
         oh = oh_ref[:]
         sl = pl.ds(ti * _GS_TT, _GS_TT)
+        if "nodot" in _GS_ABLATE:
+            sum_ref[sl, :] += local[:, :16]
+            cnt_ref[sl, :] += okf[:, :16]
+            return
         # HIGHEST: the MXU's default bf16 input truncation would round
         # every rate to 8 mantissa bits (bf16(0.1) = 0.10009765625)
+        prec = (jax.lax.Precision.DEFAULT if "lowdot" in _GS_ABLATE
+                else jax.lax.Precision.HIGHEST)
         sum_ref[sl, :] += jnp.dot(local, oh,
                                   preferred_element_type=jnp.float32,
-                                  precision=jax.lax.Precision.HIGHEST)
+                                  precision=prec)
         cnt_ref[sl, :] += jnp.dot(okf, oh,
                                   preferred_element_type=jnp.float32,
-                                  precision=jax.lax.Precision.HIGHEST)
+                                  precision=prec)
 
     jax.lax.fori_loop(0, n_ttiles, t_loop, None)
 

@@ -241,6 +241,46 @@ class ExecPlan:
 
 
 @dataclass
+class ConcatExec(ExecPlan):
+    """Concatenate children's series onto one grid (the reference's
+    LocalPartitionDistConcatExec over pushed-down per-shard plans,
+    exec/DistConcatExec.scala). Children evaluate disjoint series sets
+    (each series lives on exactly one shard), so plain concatenation is
+    the correct union."""
+    children: Sequence[ExecPlan]
+    stats: QueryStats
+
+    def execute(self):
+        import numpy as np
+        outs = [c.execute() for c in self.children]
+        grids = [o for o in outs if isinstance(o, GridResult)]
+        if not grids:
+            return outs[0]
+        steps = grids[0].steps
+        keys = [k for g in grids for k in g.keys]
+        vals = (np.concatenate([g.values for g in grids], axis=0)
+                if grids else np.zeros((0, steps.size)))
+        hv = None
+        les = None
+        if any(g.hist_values is not None for g in grids):
+            hvs = [g.hist_values for g in grids
+                   if g.hist_values is not None]
+            nb = max(h.shape[2] for h in hvs)
+            hv = np.concatenate(
+                [np.pad(h, ((0, 0), (0, 0), (0, nb - h.shape[2])),
+                        constant_values=np.nan) for h in hvs], axis=0)
+            les = next(g.bucket_les for g in grids
+                       if g.bucket_les is not None)
+        return GridResult(steps, keys, vals, hist_values=hv,
+                          bucket_les=les)
+
+    def plan_tree(self, indent: int = 0) -> str:
+        pads = " " * indent
+        kids = "\n".join(c.plan_tree(indent + 2) for c in self.children)
+        return f"{pads}ConcatExec\n{kids}"
+
+
+@dataclass
 class LocalEngineExec(ExecPlan):
     """Evaluate a LogicalPlan on the single-process engine over a pruned
     shard subset (InProcessPlanDispatcher.scala:25 semantics)."""
@@ -643,11 +683,103 @@ class QueryPlanner:
         pushed = self._try_remote_pushdown(plan)
         if pushed is not None:
             return pushed
+        pushed = self._try_pushdown_join(plan)
+        if pushed is not None:
+            return pushed
         mesh_plan = self._try_mesh_lowering(plan)
         if mesh_plan is not None:
             return mesh_plan
         return LocalEngineExec(plan, self._resolve_shards(plan),
                                self.backend, self.stats, self.limits)
+
+    def _plan_shard_set(self, plan) -> Optional[frozenset]:
+        """Pruned shard-number set of a (sub)plan, or None when any leaf
+        can't prune."""
+        leaves = walk_leaf_filters(plan)
+        if not leaves:
+            return None
+        nums: set = set()
+        for filters in leaves:
+            subset = self.shards_from_filters(filters)
+            if subset is None:
+                return None
+            nums.update(subset)
+        return frozenset(nums)
+
+    def _try_pushdown_join(self, plan) -> Optional[ExecPlan]:
+        """Per-node shard-aligned binary-join pushdown
+        (SingleClusterPlanner.scala:649 materializeWithPushdown /
+        LogicalPlanUtils.getPushdownKeys): when every matching pair of
+        series is provably CO-LOCATED, each owning node evaluates the
+        join over its local shards and the entry node concatenates
+        joined results — raw series never cross the network.
+
+        Co-location proof under this framework's shard routing
+        (ingestion_shard hashes ws/ns/METRIC plus the part hash): both
+        sides must select the SAME single metric and match on the full
+        label set (no on/ignoring) — then matching series have identical
+        labels, identical hashes, and the same shard. The reference
+        proves the on-clause case via target schemas
+        (sameRawSeriesTargetSchemaColumns); without target schemas those
+        joins stay on the entry node."""
+        if not isinstance(plan, lp.BinaryJoin) or not self.peers \
+                or self.mapper is None:
+            return None
+        if getattr(plan, "on", None) or getattr(plan, "ignoring", ()):
+            return None
+        metrics = set()
+        for filters in walk_leaf_filters(plan):
+            got = [f.value for f in filters
+                   if f.label in (self.metric_column,) + METRIC_LABELS
+                   and f.op == "eq"]
+            if len(got) != 1:
+                return None
+            metrics.add(got[0])
+        if len(metrics) != 1:
+            return None
+        lshards = self._plan_shard_set(plan.lhs)
+        rshards = self._plan_shard_set(plan.rhs)
+        if lshards is None or rshards is None or lshards != rshards:
+            return None
+        nums = sorted(lshards)
+        if set(self.mapper.active_shards(nums)) != set(nums):
+            return None          # down shards: let the general path warn
+        by_node: Dict[str, List[int]] = {}
+        for n in nums:
+            node = self.mapper.node_of(n)
+            if node is None:
+                return None
+            by_node.setdefault(node, []).append(n)
+        if len(by_node) < 2:
+            return None          # single node: whole-query pushdown owns it
+        fw = self._forwardable(plan)
+        if fw is None:
+            return None
+        query, start, step, end = fw
+        children: List[ExecPlan] = []
+        for node, group in sorted(by_node.items()):
+            if node == self.node_id:
+                local = [self._by_num[n] for n in group
+                         if n in self._by_num]
+                children.append(LocalEngineExec(
+                    plan, local, self.backend, self.stats, self.limits))
+                continue
+            gaddr = self.grpc_peers.get(node)
+            if gaddr:
+                from filodb_tpu.grpcsvc import GrpcRemoteExec
+                pw = self._plan_wire_of(plan)
+                children.append(GrpcRemoteExec(
+                    query, start, step, end, node, gaddr, self.dataset,
+                    stats=self.stats, local_only=True,
+                    plan_wire=pw[0] if pw else b""))
+            elif node in self.peers:
+                from filodb_tpu.parallel.cluster import PromQlRemoteExec
+                children.append(PromQlRemoteExec(
+                    query, start, step, end, node, self.peers[node],
+                    self.dataset, stats=self.stats, local_only=True))
+            else:
+                return None
+        return ConcatExec(children, self.stats)
 
     def _try_remote_pushdown(self, plan) -> Optional[ExecPlan]:
         """Whole-query forwarding when EVERY pruned shard lives on ONE
@@ -665,12 +797,24 @@ class QueryPlanner:
         nodes = {s.node_id for s in shards}
         if len(nodes) != 1:
             return None
+        g = shards[0]
+        gaddr = self.grpc_peers.get(g.node_id)
         fw = self._forwardable(plan)
+        if gaddr:
+            # gRPC peers take the STRUCTURAL plan tree (exec_plan.proto
+            # capability): no dependence on the PromQL printer, so even
+            # unprintable plans (subqueries etc.) push down whole
+            pw = self._plan_wire_of(plan)
+            if pw is not None:
+                wire_bytes, start, step, end = pw
+                from filodb_tpu.grpcsvc import GrpcRemoteExec
+                return GrpcRemoteExec(
+                    fw[0] if fw else f"<plan:{type(plan).__name__}>",
+                    start, step, end, g.node_id, gaddr, g.dataset,
+                    stats=self.stats, plan_wire=wire_bytes)
         if fw is None:
             return None
         query, start, step, end = fw
-        g = shards[0]
-        gaddr = self.grpc_peers.get(g.node_id)
         if gaddr:
             from filodb_tpu.grpcsvc import GrpcRemoteExec
             return GrpcRemoteExec(query, start, step, end, g.node_id,
@@ -678,6 +822,19 @@ class QueryPlanner:
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end, g.node_id,
                                 g.base_url, g.dataset, stats=self.stats)
+
+    def _plan_wire_of(self, plan):
+        """(wire_bytes, start, step, end) when the plan serializes
+        structurally and carries an evaluation range, else None."""
+        rng = plan_range(plan)
+        if rng is None:
+            return None
+        start, step, end, _, _ = rng
+        try:
+            from filodb_tpu.query.planwire import plan_to_wire
+            return plan_to_wire(plan), start, step, end
+        except ValueError:
+            return None
 
     def execute(self, plan):
         return self.materialize(plan).execute()

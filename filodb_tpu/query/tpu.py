@@ -386,6 +386,11 @@ def _window_endpoint_pallas(func, ts, vals, lens, w0s, w0e, step, nsteps):
                              jnp.asarray(w0e), jnp.asarray(step))
 
 
+# tests set this to exercise the fused group-sum kernel in interpret
+# mode on the CPU test mesh; production CPU nodes leave it off
+FUSED_GROUPSUM_INTERPRET = False
+
+
 class TpuBackend:
     """Pluggable device backend for QueryEngine (the ``--exec-backend=tpu``
     boundary from BASELINE.json)."""
@@ -581,6 +586,14 @@ class TpuBackend:
 
         if func not in ("rate", "increase", "delta") or not len(series):
             return None
+        import jax
+        on_cpu = jax.default_backend() == "cpu"
+        if on_cpu and not FUSED_GROUPSUM_INTERPRET:
+            # interpret-mode Pallas re-traces per tile shape — with live
+            # ingest growing the tiles that is seconds per query; CPU
+            # nodes take the vectorized-numpy path instead (tests flip
+            # the flag to exercise the kernel in interpret mode)
+            return None
         tiles, idx, _, _ = self._tile_entry(series)
         if tiles is None or len(idx) != len(series):
             return None
@@ -594,10 +607,9 @@ class TpuBackend:
         onehot = np.zeros((len(series), G), np.float32)
         onehot[np.arange(len(series)), np.asarray(gids)[np.asarray(idx)]] \
             = 1.0
-        import jax
         res = tst.groupsum_counters(
             tiles, func, steps, window_ms, onehot, offset_ms,
-            interpret=jax.default_backend() == "cpu")
+            interpret=on_cpu)
         if res is None:
             return None
         self.fused_aggs += 1

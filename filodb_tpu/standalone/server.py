@@ -74,6 +74,14 @@ DEFAULTS = {
     "num-nodes": 1,
     "node-ordinal": 0,
     "peers": {},
+    # seed discovery (akka-bootstrapper AkkaBootstrapper.scala:31): when
+    # "peers" is empty, resolve them at startup —
+    #   {"mode": "dns-srv", "srv-name": "_filodb._tcp.ns.svc"} or
+    #   {"mode": "consul", "url": "http://consul:8500", "service": "filodb"}
+    # "advertise-url" identifies THIS node among the discovered seeds
+    # (ordinals follow the sorted seed list on every node).
+    "discovery": None,
+    "advertise-url": None,
     # HA buddy replica cluster (HighAvailabilityPlanner.scala:31): maps a
     # node id to the SAME-ordinal node of a replica cluster ingesting the
     # same streams; queries route a DOWN node's shards to its buddy
@@ -93,11 +101,17 @@ DEFAULTS = {
     "card-quotas": {},
     "failure-detect-interval-s": 0.5,
     "failure-detect-threshold": 3,
+    # per-tenant cardinality gauges published on a timer
+    # (TenantIngestionMetering.scala; 0 = off)
+    "tenant-metering-interval-s": 60,
     # gRPC query service port (PromQLGrpcServer.scala; 0 = ephemeral,
-    # None = off). Peers advertise theirs via "grpc-peers":
-    # {node_id: "host:port"} — leaf dispatch and pushdown then ride
-    # protobuf + NibblePack over persistent channels instead of JSON.
-    "grpc-port": None,
+    # None = off). ON by default: this is the data plane — leaf dispatch
+    # and pushdown ride protobuf + NibblePack over persistent channels;
+    # base64-JSON HTTP remains the control plane and the fallback. Fixed
+    # peer addrs can be given via "grpc-peers" {node_id: "host:port"};
+    # otherwise each node advertises its ephemeral port in its health
+    # body and peers learn it through the failure detector's gossip.
+    "grpc-port": 0,
     "grpc-peers": {},
     "grpc-partitions": {},
     # elastic recovery (ShardManager.scala:28 assignShardsToNodes): when a
@@ -170,6 +184,31 @@ class FiloServer:
         n = self.config["num-shards"]
         num_nodes = int(self.config.get("num-nodes", 1))
         ordinal = int(self.config.get("node-ordinal", 0))
+        # seed discovery (akka-bootstrapper analogue): resolve the peer
+        # map + this node's ordinal from DNS-SRV/Consul when no explicit
+        # peer list is configured
+        disc = self.config.get("discovery")
+        if disc and not self.config.get("peers"):
+            from filodb_tpu.parallel.discovery import discover_peers
+            all_nodes = discover_peers(disc)
+            adv = self.config.get("advertise-url")
+            if adv is None:
+                raise ValueError(
+                    "discovery needs advertise-url to identify this "
+                    "node among the discovered seeds")
+            me = [nid for nid, url in all_nodes.items()
+                  if url.rstrip("/") == adv.rstrip("/")]
+            if len(me) != 1:
+                raise ValueError(
+                    f"advertise-url {adv!r} matched {len(me)} "
+                    f"discovered seeds {sorted(all_nodes.values())}")
+            ordinal = int(me[0].removeprefix("node"))
+            num_nodes = len(all_nodes)
+            self.config["num-nodes"] = num_nodes
+            self.config["node-ordinal"] = ordinal
+            self.config["peers"] = {nid: url for nid, url
+                                    in all_nodes.items()
+                                    if nid != me[0]}
         if num_nodes > 1:
             self.node_id = f"node{ordinal}"
             self.owned_shards = shards_for_ordinal(ordinal, num_nodes, n)
@@ -273,10 +312,18 @@ class FiloServer:
                 reassign_grace_s=(float(grace) if grace is not None
                                   else None),
                 on_node_down=self._on_node_down,
-                on_node_up=self._on_node_up).start()
+                on_node_up=self._on_node_up,
+                grpc_peer_sink=self.http.grpc_peers).start()
             # the health body advertises this node's down-view (quorum
             # input) and served-shard statuses (gossip) to its peers
             self.http.detector = self.detector
+        self.tenant_metering = None
+        meter_s = float(self.config.get("tenant-metering-interval-s", 0))
+        if meter_s > 0 and self.card_trackers:
+            from filodb_tpu.core.metering import TenantMetering
+            self.tenant_metering = TenantMetering(
+                self.card_trackers, interval_s=meter_s).start()
+            self.http.tenant_metering = self.tenant_metering
         if streaming:
             self._start_ingestion()
         return self
@@ -480,6 +527,8 @@ class FiloServer:
     def stop(self) -> None:
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop()
+        if getattr(self, "tenant_metering", None) is not None:
+            self.tenant_metering.stop()
         if self.detector is not None:
             self.detector.stop()
         if self.gateway is not None:

@@ -85,6 +85,12 @@ class ColumnStore:
     def read_checkpoints(self, dataset: str, shard: int) -> Dict[int, int]:
         raise NotImplementedError
 
+    def delete_part_keys(self, dataset: str, shard: int,
+                         part_keys: Sequence[bytes]) -> None:
+        """Remove series (index entries + chunks) — the cardinality
+        buster's primitive."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -111,6 +117,9 @@ class NullColumnStore(ColumnStore):
 
     def read_checkpoints(self, dataset, shard):
         return {}
+
+    def delete_part_keys(self, dataset, shard, part_keys) -> None:
+        pass
 
 
 class FlatFileColumnStore(ColumnStore):
@@ -306,6 +315,51 @@ class FlatFileColumnStore(ColumnStore):
                         break
                     latest[pk] = PartKeyEntry(pk, st, en)
         return iter(latest.values())
+
+    def delete_part_keys(self, dataset, shard, part_keys) -> None:
+        """Compact both logs without the doomed series (the append-only
+        analogue of the reference cardbuster's Cassandra deletes). One
+        writer per shard is the store's standing contract, so the
+        rewrite is safe against concurrent appends."""
+        doomed = set(part_keys)
+        if not doomed:
+            return
+        # part keys: rewrite keeping the LATEST entry per surviving key
+        self._validate_pk_log(dataset, shard)
+        pk_path = self._pk_path(dataset, shard)
+        survivors = [e for e in self.scan_part_keys(dataset, shard)
+                     if e.part_key not in doomed]
+        tmp = pk_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in survivors:
+                f.write(_PK_HDR.pack(_PK_MAGIC, len(e.part_key),
+                                     e.start_ts, e.end_ts))
+                f.write(e.part_key)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, pk_path)
+        # chunks: rewrite the log without the doomed keys' records
+        idx = self._ensure_chunk_index(dataset, shard)
+        ch_path = self._chunks_path(dataset, shard)
+        keep_offs = sorted(off for pk, chunks in idx.items()
+                           if pk not in doomed
+                           for off in chunks.values())
+        tmp = ch_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for c in self._iter_chunks(dataset, shard, keep_offs):
+                vec_lens = struct.pack(f"<{len(c.vectors)}i",
+                                       *[len(v) for v in c.vectors])
+                f.write(_CHUNK_HDR.pack(
+                    _CHUNK_MAGIC, len(c.part_key), len(c.vectors), 0,
+                    c.chunk_id, c.num_rows, c.start_ts, c.end_ts))
+                f.write(c.part_key)
+                f.write(vec_lens)
+                for v in c.vectors:
+                    f.write(v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, ch_path)
+        self._chunk_index.pop((dataset, shard), None)
 
     # -- checkpoints (CheckpointTable.scala:26) ----------------------------
     def write_checkpoint(self, dataset, shard, group, offset) -> None:

@@ -20,3 +20,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# exercise the fused Pallas group-sum path (interpret mode) on the CPU
+# test mesh; production CPU nodes keep it off (tpu.py gate)
+from filodb_tpu.query import tpu as _tpu  # noqa: E402
+
+_tpu.FUSED_GROUPSUM_INTERPRET = True

@@ -1,0 +1,87 @@
+"""Seed discovery (akka-bootstrapper analogue): DNS-SRV and Consul peer
+resolution with deterministic cluster-wide ordinals, and the standalone
+server's discovery-driven bootstrap."""
+
+import pytest
+
+from filodb_tpu.parallel.discovery import discover_peers
+
+
+def test_explicit_list_passthrough():
+    peers = {"node0": "http://a:1", "node1": "http://b:2"}
+    assert discover_peers({"mode": "explicit-list",
+                           "peers": peers}) == peers
+    assert discover_peers({}) == {}
+
+
+def test_dns_srv_deterministic_ordinals():
+    """Every node resolves the same SRV name; sorted targets give the
+    same node ids regardless of DNS answer order."""
+    answers = [("host-b.local", 9090), ("host-a.local", 9090),
+               ("host-c.local", 9091)]
+    got = discover_peers({"mode": "dns-srv",
+                          "srv-name": "_filodb._tcp.cluster.local"},
+                         srv_resolver=lambda name: list(answers))
+    shuffled = discover_peers({"mode": "dns-srv",
+                               "srv-name": "_filodb._tcp.cluster.local"},
+                              srv_resolver=lambda name: answers[::-1])
+    assert got == shuffled
+    assert got == {"node0": "http://host-a.local:9090",
+                   "node1": "http://host-b.local:9090",
+                   "node2": "http://host-c.local:9091"}
+
+
+def test_consul_catalog():
+    rows = [{"Address": "10.0.0.2", "ServiceAddress": "",
+             "ServicePort": 8080},
+            {"Address": "10.0.0.1", "ServiceAddress": "10.0.0.1",
+             "ServicePort": 8080}]
+    seen = {}
+
+    def fetch(url):
+        seen["url"] = url
+        return rows
+    got = discover_peers({"mode": "consul",
+                          "url": "http://consul:8500/",
+                          "service": "filodb"}, consul_fetcher=fetch)
+    assert seen["url"] == "http://consul:8500/v1/catalog/service/filodb"
+    assert got == {"node0": "http://10.0.0.1:8080",
+                   "node1": "http://10.0.0.2:8080"}
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        discover_peers({"mode": "zookeeper"})
+
+
+def test_server_bootstrap_via_discovery(tmp_path, monkeypatch):
+    """A FiloServer with no explicit peers derives ordinal + peer map
+    from discovery and its advertise-url."""
+    import filodb_tpu.parallel.discovery as disc_mod
+    from filodb_tpu.standalone.server import FiloServer
+
+    def fake_resolver(name):
+        return [("127.0.0.1", 7101), ("127.0.0.1", 7102)]
+    monkeypatch.setattr(disc_mod, "_default_srv_resolver",
+                        lambda name: fake_resolver(name))
+    srv = FiloServer({
+        "num-shards": 4, "port": 0,
+        "discovery": {"mode": "dns-srv", "srv-name": "_f._tcp.x"},
+        "advertise-url": "http://127.0.0.1:7102",
+    })
+    srv.start()
+    try:
+        assert srv.node_id == "node1"
+        assert srv.config["num-nodes"] == 2
+        assert srv.config["peers"] == {"node0": "http://127.0.0.1:7101"}
+        assert sorted(srv.owned_shards) == [2, 3]
+    finally:
+        srv.stop()
+
+    # unmatched advertise-url fails loudly rather than joining wrong
+    with pytest.raises(ValueError):
+        FiloServer({
+            "num-shards": 4, "port": 0,
+            "discovery": {"mode": "dns-srv", "srv-name": "_f._tcp.x"},
+            "advertise-url": "http://10.9.9.9:1",
+        }).start()

@@ -87,6 +87,17 @@ def _poll(fn, timeout=90.0, interval=0.25):
     raise TimeoutError(f"poll timed out; last={last!r}")
 
 
+def _grpc_rpcs(port) -> int:
+    """grpc_rpcs_served_total from a node's /metrics exposition."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        txt = r.read().decode()
+    for line in txt.splitlines():
+        if "grpc_rpcs_served_total" in line:
+            return int(float(line.split()[-1]))
+    return 0
+
+
 def _series_instances(port):
     """All heap_usage-family series visible via an unpruned query."""
     # regex selector: unprunable (fans to all shards on both nodes) and
@@ -174,6 +185,22 @@ def test_cross_node_query_and_peer_death(cluster):
                 query="rate(http_requests_total[5m])",
                 start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60)
     assert len(body["data"]["result"]) == N_INSTANCES
+
+    # the binary data plane carries leaf dispatch on BOTH nodes: peers
+    # discover each other's ephemeral gRPC ports through health-body
+    # gossip, so poll until a cross-node query rides protobuf frames
+    def _grpc_plane():
+        _series_instances(p0)
+        _series_instances(p1)
+        _get(p0, "/promql/timeseries/api/v1/query_range",
+             query="rate(http_requests_total[5m])",
+             start=T0 + 300, end=T0 + 900, step=60)
+        _get(p1, "/promql/timeseries/api/v1/query_range",
+             query="rate(http_requests_total[5m])",
+             start=T0 + 300, end=T0 + 900, step=60)
+        served = [_grpc_rpcs(p0), _grpc_rpcs(p1)]
+        return all(s > 0 for s in served), served
+    _poll(_grpc_plane, timeout=30)
 
     # -- kill node1: survivor flips its shards DOWN, queries exclude ------
     os.kill(procs[1].pid, signal.SIGKILL)

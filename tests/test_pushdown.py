@@ -66,6 +66,25 @@ def test_unprintable_shapes_return_none():
     assert plan_to_promql(plan) is None
 
 
+@pytest.mark.parametrize("q", [
+    'rate(reqs_total{instance="i0"}[5m])',
+    "sum(rate(reqs_total[5m])) by (instance)",
+    "(rate(a_total[5m])) * on (instance) group_left() (rate(b_total[5m]))",
+    "max_over_time(rate(c_total[5m])[30m:1m])",     # printer can't, wire can
+    "histogram_quantile(0.99, sum(rate(lat[5m])))",
+    'label_replace(cpu, "dst", "$1", "src", "(.*)")',
+    "avg_over_time(cpu[10m:] @ end())",
+])
+def test_plan_wire_roundtrip(q):
+    """Structural plan serialization (exec_plan.proto analogue) carries
+    every plan shape — including ones the PromQL printer cannot."""
+    from filodb_tpu.query.planwire import plan_from_wire, plan_to_wire
+    tsp = TimeStepParams(T0, 60, T0 + 600)
+    plan = parse_query_range(q, tsp)
+    buf = plan_to_wire(plan)
+    assert plan_from_wire(buf) == plan
+
+
 # --- pushdown against an in-process two-node cluster -----------------------
 
 @pytest.fixture
@@ -166,6 +185,61 @@ def test_whole_query_pushdown_matches_local(two_nodes):
     assert ok.any()
     np.testing.assert_allclose(got.values[0][ok], want.values[0][ok],
                                rtol=1e-9)
+
+
+def test_join_pushdown_across_nodes_ships_joined_results(two_nodes):
+    """A shard-aligned self-join spanning BOTH nodes executes per node
+    (each node joins its local shards) and the entry node concatenates
+    joined results — raw series never cross the network
+    (SingleClusterPlanner.scala:649 materializeWithPushdown)."""
+    from filodb_tpu.parallel.cluster import PromQlRemoteExec
+    from filodb_tpu.query.engine import QueryEngine
+    from filodb_tpu.query.planner import ConcatExec, LocalEngineExec
+    srv0, srv1 = two_nodes
+    ns0 = _ns_on_node(srv0, "xg", "node0")
+    ns1 = _ns_on_node(srv0, "xg", "node1")
+    _seed_metric(srv0, "xg", ns0, counter=False)
+    _seed_metric(srv1, "xg", ns1, counter=False)
+    planner = _planner0(srv0, srv1)
+    tsp = TimeStepParams(T0 + 300, 60, T0 + 500)
+    sel = f'xg{{_ws_="demo",_ns_=~"{ns0}|{ns1}"}}'
+    plan = parse_query_range(f"({sel}) + ({sel})", tsp)
+    ex = planner.materialize(plan)
+    assert isinstance(ex, ConcatExec), ex.plan_tree()
+    kinds = {type(c).__name__ for c in ex.children}
+    assert kinds == {"LocalEngineExec", "PromQlRemoteExec"}, kinds
+    # the remote child carries the whole JOIN (printed PromQL), pinned
+    # to the peer's local shards
+    remote = next(c for c in ex.children
+                  if isinstance(c, PromQlRemoteExec))
+    assert remote.local_only and "+" in remote.query
+    got = ex.execute()
+    # oracle: single engine over ALL shards of both nodes
+    both = list(srv0.store.shards(srv0.ref)) + \
+        list(srv1.store.shards(srv1.ref))
+    want = QueryEngine(both).execute(plan)
+    assert got.num_series == want.num_series == 6
+    gk = sorted(tuple(sorted(k.items())) for k in got.keys)
+    wk = sorted(tuple(sorted(k.items())) for k in want.keys)
+    assert gk == wk
+    order = np.argsort([str(sorted(k.items())) for k in got.keys])
+    worder = np.argsort([str(sorted(k.items())) for k in want.keys])
+    np.testing.assert_allclose(got.values[order], want.values[worder],
+                               rtol=1e-9, equal_nan=True)
+
+
+def test_join_pushdown_cross_metric_stays_local(two_nodes):
+    """Different metrics on the two sides can match across shards (the
+    shard hash includes the metric), so the join must NOT decompose."""
+    from filodb_tpu.query.planner import ConcatExec
+    srv0, srv1 = two_nodes
+    planner = _planner0(srv0, srv1)
+    tsp = TimeStepParams(T0 + 300, 60, T0 + 500)
+    plan = parse_query_range('(heap_usage{_ws_="demo",_ns_="App-0"}) / '
+                             '(heap_usage2{_ws_="demo",_ns_="App-0"})',
+                             tsp)
+    ex = planner.materialize(plan)
+    assert not isinstance(ex, ConcatExec)
 
 
 def test_join_pushdown_same_node(two_nodes):

@@ -28,6 +28,17 @@ N_SERIES = 16           # spread across all shards
 N_SAMPLES = 40
 
 
+def _grpc_rpcs(port) -> int:
+    """grpc_rpcs_served_total from a node's /metrics exposition."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        txt = r.read().decode()
+    for line in txt.splitlines():
+        if "grpc_rpcs_served_total" in line:
+            return int(float(line.split()[-1]))
+    return 0
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -185,6 +196,16 @@ def test_sigkill_node_without_buddy_recovers_full_coverage(tmp_path):
                  for s in range(N_SERIES)}
         _poll(lambda: ((lambda got: (got == want2, got))(
             _instances_at(ports[0], N_SAMPLES + 10))))
+
+        # the whole e2e rode the default binary data plane: every
+        # survivor served gRPC leaf fetches (discovered via health-body
+        # gossip, no configured addresses)
+        def _grpc_both():
+            _instances_at(ports[0], N_SAMPLES + 10)
+            _instances_at(ports[2], N_SAMPLES + 10)
+            served = [_grpc_rpcs(ports[0]), _grpc_rpcs(ports[2])]
+            return all(s > 0 for s in served), served
+        _poll(_grpc_both, timeout=30)
     finally:
         for p in procs.values():
             if p.poll() is None:

@@ -1,0 +1,87 @@
+"""String columnar vectors: const / dict-UTF8 (multi-width index) / raw
+UTF8 codecs, and a string-valued data column round-tripping through
+ingest -> encode -> chunk decode -> merged read.
+
+(Parity model: memory/format/vectors/UTF8Vector.scala,
+DictUTF8Vector.scala, ConstVector.scala; multi-width index stream per
+IntBinaryVector.scala:15.)"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import (Column, ColumnType, DataSchema,
+                                     DatasetRef, Schemas)
+from filodb_tpu.memory import vectors as bv
+
+T0 = 1_600_000_000_000
+
+
+@pytest.mark.parametrize("vals,kind", [
+    (["up"] * 50, bv.K_STR_CONST),
+    ((["ok", "warn", "crit"] * 40), bv.K_STR_DICT),
+    ([f"unique-{i}" * 40 for i in range(300)], bv.K_STR_UTF8),
+])
+def test_string_codec_roundtrip(vals, kind):
+    buf = bv.encode_strings(vals)
+    assert buf[0] == kind
+    got = bv.decode_strings(buf)
+    assert list(got) == list(vals)
+
+
+def test_string_codec_wide_dict_uses_16bit_indices():
+    vals = [f"v{i % 1000}" for i in range(3000)]
+    buf = bv.encode_strings(vals)
+    assert buf[0] == bv.K_STR_DICT
+    assert list(bv.decode_strings(buf)) == vals
+    # dict + 16-bit codes beat the raw offsets+blob form
+    raw = (["x" * 6] * 0) or None
+    assert len(buf) < 3000 * 4 + sum(len(v) for v in vals)
+
+
+def test_string_codec_empty_and_none():
+    buf = bv.encode_strings([])
+    assert list(bv.decode_strings(buf)) == []
+    buf = bv.encode_strings([None, "a", None])
+    assert list(bv.decode_strings(buf)) == ["", "a", ""]
+
+
+STRING_SCHEMAS = Schemas(schemas={
+    "event": DataSchema(
+        name="event",
+        columns=(Column("timestamp", ColumnType.LONG),
+                 Column("count", ColumnType.DOUBLE),
+                 Column("level", ColumnType.STRING)),
+        value_column="count"),
+})
+
+
+def test_string_column_roundtrip_through_shard():
+    shard = TimeSeriesShard(DatasetRef("ev"), STRING_SCHEMAS, 0,
+                            max_chunk_rows=40)
+    b = RecordBuilder(STRING_SCHEMAS)
+    levels = ["info", "warn", "info", "error"]
+    for t in range(100):
+        b.add_sample("event", {"_metric_": "app_events", "_ws_": "w",
+                               "_ns_": "n"},
+                     T0 + t * 1000, float(t), levels[t % 4])
+    for c in b.containers():
+        shard.ingest(c)
+    part = next(iter(shard.partitions.values()))
+    # encoded chunks exist (40-row buffers switched twice) + live tail
+    assert part.num_chunks >= 2
+    col_i = STRING_SCHEMAS.by_name("event").columns.index(
+        next(c for c in STRING_SCHEMAS.by_name("event").columns
+             if c.col_type == ColumnType.STRING))
+    ts, vals, chunk_len = part.read_full(col_i)
+    assert ts.size == 100
+    assert chunk_len < 100          # tail rows merged from live buffer
+    assert list(vals) == [levels[t % 4] for t in range(100)]
+    # the encoded vector is dict-encoded (4 distinct values)
+    assert part.chunks[0].vectors[col_i][0] == bv.K_STR_DICT
+    # flush the tail; the full read now comes from chunks alone
+    shard.flush_all()
+    ts2, vals2, chunk_len2 = part.read_full(col_i)
+    assert chunk_len2 == 100
+    assert list(vals2) == list(vals)
