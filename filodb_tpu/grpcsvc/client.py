@@ -5,7 +5,15 @@ dispatch) and ``GrpcRemoteExec`` replaces ``PromQlRemoteExec``
 (whole-query pushdown / federation) when a peer advertises a gRPC
 address. Channels are cached per address — gRPC keeps one persistent
 HTTP/2 connection per peer and multiplexes RPCs over it
-(PromQLGrpcServer.scala client side; RemoteActorPlanDispatcher)."""
+(PromQLGrpcServer.scala client side; RemoteActorPlanDispatcher).
+
+Degraded-mode behavior (parallel/resilience.py): transport failures map
+to TransportError, retry per policy inside the query's deadline budget,
+and count against the peer address's circuit breaker. When the binary
+data plane is exhausted (retries spent or breaker open) and the caller
+provided an HTTP fallback URL, the call falls back to the JSON control
+plane — a restarted peer whose gRPC port moved keeps serving through
+HTTP while the failure detector re-learns the new address."""
 
 from __future__ import annotations
 
@@ -15,7 +23,11 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from filodb_tpu.grpcsvc import wire
+from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
+                                            RetryPolicy, TransportError,
+                                            resilient_call)
 from filodb_tpu.query.model import QueryError, RawSeries
+from filodb_tpu.testing import chaos
 
 _SERVICE = "filodb.QueryService"
 _channels: Dict[str, object] = {}
@@ -32,34 +44,77 @@ def _channel(addr: str):
         return ch
 
 
+def drop_channel(addr: str) -> None:
+    """Evict + close the cached channel for a peer that died or moved
+    to a new ephemeral port (the failure detector calls this when the
+    peer sink is invalidated)."""
+    with _channels_lock:
+        ch = _channels.pop(addr, None)
+    if ch is not None:
+        try:
+            ch.close()
+        except Exception:
+            pass
+
+
 def _call(addr: str, method: str, payload: bytes, timeout_s: float,
           node_id: str) -> bytes:
     import grpc
-    stub = _channel(addr).unary_unary(
-        f"/{_SERVICE}/{method}",
-        request_serializer=lambda b: b,
-        response_deserializer=lambda b: b)
     try:
+        chaos.fire("grpc.call", node=node_id, addr=addr, method=method)
+        stub = _channel(addr).unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
         return stub(payload, timeout=timeout_s)
     except grpc.RpcError as e:
-        raise QueryError(f"remote node {node_id} grpc unreachable: "
-                         f"{e.code().name}")
+        raise TransportError(f"remote node {node_id} grpc unreachable: "
+                             f"{e.code().name}")
+    except OSError as e:                     # injected/chaos connection
+        raise TransportError(f"remote node {node_id} grpc unreachable: "
+                             f"{e}")
 
 
 class GrpcShardGroup:
     """Peer leaf dispatch over gRPC (see RemoteShardGroup for the plan
-    contract: stands in a planner shard list for one peer's shards)."""
+    contract: stands in a planner shard list for one peer's shards).
+
+    ``http_fallback`` (the peer's HTTP base URL) downgrades the fetch to
+    the JSON control plane when the gRPC plane is exhausted."""
 
     def __init__(self, node_id: str, addr: str, dataset: str,
                  shard_nums: Optional[Sequence[int]],
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerRegistry] = None,
+                 deadline: Optional[Deadline] = None,
+                 allow_partial: bool = False,
+                 http_fallback: Optional[str] = None):
         self.node_id = node_id
         self.addr = addr
         self.dataset = dataset
         self.shard_nums = list(shard_nums) if shard_nums is not None \
             else None
         self.timeout_s = timeout_s
+        self.retry = retry
+        self.breakers = breakers
+        self.deadline = deadline
+        self.allow_partial = allow_partial
+        self.http_fallback = http_fallback
         self.shard_num = tuple(self.shard_nums or ())
+
+    def describe(self) -> str:
+        sh = ("all" if self.shard_nums is None
+              else ",".join(map(str, self.shard_nums)))
+        return f"shards [{sh}] on {self.node_id}"
+
+    def _http_group(self):
+        from filodb_tpu.parallel.cluster import RemoteShardGroup
+        return RemoteShardGroup(
+            self.node_id, self.http_fallback, self.dataset,
+            self.shard_nums, timeout_s=self.timeout_s, retry=self.retry,
+            breakers=self.breakers, deadline=self.deadline,
+            allow_partial=self.allow_partial)
 
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],
@@ -67,8 +122,22 @@ class GrpcShardGroup:
         payload = wire.encode_raw_request(
             self.dataset, filters, start_ms, end_ms, column,
             self.shard_nums, span_snap=bool(full))
-        buf = _call(self.addr, "FetchRaw", payload, self.timeout_s,
-                    self.node_id)
+
+        def dial(timeout_s: float) -> bytes:
+            return _call(self.addr, "FetchRaw", payload, timeout_s,
+                         self.node_id)
+
+        try:
+            buf = resilient_call(
+                dial, key=self.addr, node_id=self.node_id,
+                timeout_s=self.timeout_s, retry=self.retry,
+                breakers=self.breakers, deadline=self.deadline)
+        except TransportError:
+            if self.http_fallback is None:
+                raise
+            # binary plane down: downgrade to the JSON control plane
+            return self._http_group().fetch_raw(
+                filters, start_ms, end_ms, column, full=full)
         series, error = wire.decode_raw_response(buf)
         if error:
             raise QueryError(f"remote node {self.node_id}: {error}")
@@ -81,12 +150,17 @@ class GrpcShardGroup:
 class GrpcRemoteExec:
     """Whole-query pushdown over gRPC: the peer evaluates the PromQL and
     ships the grid as packed columns (PromQlRemoteExec semantics without
-    the JSON hop)."""
+    the JSON hop). Falls back to PromQlRemoteExec over ``http_fallback``
+    when the binary plane is exhausted."""
 
     def __init__(self, query: str, start_ms: int, step_ms: int,
                  end_ms: int, node_id: str, addr: str, dataset: str,
                  timeout_s: float = 60.0, stats=None,
-                 local_only: bool = True, plan_wire: bytes = b""):
+                 local_only: bool = True, plan_wire: bytes = b"",
+                 retry: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerRegistry] = None,
+                 deadline: Optional[Deadline] = None,
+                 http_fallback: Optional[str] = None):
         # structural plan tree (query.planwire); when present the peer
         # executes it directly and `query` is only a debug label
         self.plan_wire = plan_wire
@@ -100,6 +174,19 @@ class GrpcRemoteExec:
         self.timeout_s = timeout_s
         self.stats = stats
         self.local_only = local_only
+        self.retry = retry
+        self.breakers = breakers
+        self.deadline = deadline
+        self.http_fallback = http_fallback
+
+    def _fallback_exec(self):
+        from filodb_tpu.parallel.cluster import PromQlRemoteExec
+        return PromQlRemoteExec(
+            self.query, self.start_ms, self.step_ms, self.end_ms,
+            self.node_id, self.http_fallback, self.dataset,
+            timeout_s=self.timeout_s, stats=self.stats,
+            local_only=self.local_only, retry=self.retry,
+            breakers=self.breakers, deadline=self.deadline)
 
     def execute(self):
         from filodb_tpu.query.model import GridResult, RangeParams
@@ -107,8 +194,23 @@ class GrpcRemoteExec:
             self.dataset, self.query, self.start_ms, self.step_ms,
             self.end_ms, local_only=self.local_only,
             plan_wire=self.plan_wire)
-        buf = _call(self.addr, "Exec", payload, self.timeout_s,
-                    self.node_id)
+
+        def dial(timeout_s: float) -> bytes:
+            return _call(self.addr, "Exec", payload, timeout_s,
+                         self.node_id)
+
+        try:
+            buf = resilient_call(
+                dial, key=self.addr, node_id=self.node_id,
+                timeout_s=self.timeout_s, retry=self.retry,
+                breakers=self.breakers, deadline=self.deadline)
+        except TransportError:
+            if self.http_fallback is None:
+                raise
+            # the HTTP edge can't carry a structural plan; only PromQL-
+            # printable pushdowns downgrade (the planner only sets
+            # http_fallback when a query string exists)
+            return self._fallback_exec().execute()
         steps, keys, values, hv, les, stats, error = \
             wire.decode_exec_response(buf)
         if error:

@@ -87,6 +87,23 @@ def scalar(res: ScalarResult, instant: bool) -> Dict:
                     "result": [{"metric": {}, "values": values}]})
 
 
+def attach_degraded(out: Dict, res, stats=None) -> Dict:
+    """Surface degraded-mode markers on a response envelope: union of
+    grid- and stats-level warnings in ``warnings`` plus a top-level
+    ``"partial": true`` when any shard group was dropped (the
+    Thanos/M3 partial-response shape)."""
+    warnings = list(getattr(stats, "warnings", ()) or ())
+    partial = bool(getattr(stats, "partial", False))
+    if isinstance(res, GridResult):
+        warnings.extend(res.warnings)
+        partial = partial or res.partial
+    if warnings:
+        out["warnings"] = sorted(set(warnings))
+    if partial:
+        out["partial"] = True
+    return out
+
+
 def _metric(key: Dict[str, str]) -> Dict[str, str]:
     out = {}
     for k, v in key.items():

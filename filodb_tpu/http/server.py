@@ -24,6 +24,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from filodb_tpu.http import prom_json
+from filodb_tpu.parallel.resilience import (Deadline, DeadlineExceeded,
+                                            PeerResilience)
 from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
                                       parse_query_range, selector_to_filters)
 from filodb_tpu.query import logical as lp
@@ -58,7 +60,9 @@ class FiloHttpServer:
                  partitions: Optional[Dict[str, str]] = None,
                  local_partitions: Optional[List[str]] = None,
                  grpc_peers: Optional[Dict[str, str]] = None,
-                 grpc_partitions: Optional[Dict[str, str]] = None):
+                 grpc_partitions: Optional[Dict[str, str]] = None,
+                 query_timeout_s: float = 30.0,
+                 resilience: Optional[PeerResilience] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -77,6 +81,15 @@ class FiloHttpServer:
         self.local_partitions = list(local_partitions or ())
         self.grpc_peers = dict(grpc_peers or {})
         self.grpc_partitions = dict(grpc_partitions or {})
+        # degraded-mode execution: default per-query deadline budget +
+        # the server-lifetime retry policy / breaker registry (breaker
+        # state persists across queries by construction)
+        self.query_timeout_s = float(query_timeout_s)
+        if resilience is None:
+            from filodb_tpu.parallel.resilience import (BreakerRegistry,
+                                                        RetryPolicy)
+            resilience = PeerResilience(RetryPolicy(), BreakerRegistry())
+        self.resilience = resilience
         # set by the standalone server: FailureDetector whose down-view
         # rides the health body (quorum input for elastic reassignment)
         self.detector = None
@@ -133,6 +146,10 @@ class FiloHttpServer:
             pass
         except QueryLimitError as e:
             code, payload = 422, prom_json.error(str(e), "query_limit")
+        except DeadlineExceeded as e:
+            # clean budget-exhaustion error (Prometheus timeout shape),
+            # never a hung socket
+            code, payload = 503, prom_json.error(str(e), "timeout")
         except QueryError as e:
             code, payload = 400, prom_json.error(str(e))
         except Exception as e:   # noqa: BLE001 — edge must not crash
@@ -207,7 +224,17 @@ class FiloHttpServer:
         # shards only (no fan-back-out; loop prevention for pushdown —
         # federation forwarding is likewise disabled)
         local_dispatch = self._param(qs, "dispatch") == "local"
-        engine = self.make_planner(ds, local_dispatch=local_dispatch)
+        # degraded-mode knobs: per-query deadline budget (&timeout=,
+        # Prom-style) + opt-in partial responses (&allow_partial=true,
+        # the Thanos partial_response analogue; default fail-fast)
+        timeout_s = self._parse_duration_s(
+            self._param(qs, "timeout"), self.query_timeout_s)
+        deadline = Deadline.after(timeout_s)
+        allow_partial = (self._param(qs, "allow_partial", "")
+                         or "").lower() in ("true", "1", "yes")
+        engine = self.make_planner(ds, local_dispatch=local_dispatch,
+                                   deadline=deadline,
+                                   allow_partial=allow_partial)
         if engine is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         if rest == "query_range":
@@ -225,7 +252,9 @@ class FiloHttpServer:
             return self._remote_read(ds, body_raw)
         return 404, prom_json.error(f"no route for {path}", "not_found")
 
-    def make_planner(self, ds: str, local_dispatch: bool = False):
+    def make_planner(self, ds: str, local_dispatch: bool = False,
+                     deadline: Optional[Deadline] = None,
+                     allow_partial: bool = False):
         """Planner over this node's view of a dataset (shared by the HTTP
         endpoints and the gRPC query service). ``local_dispatch`` pins
         evaluation to local shards — no peer fan-out, no federation."""
@@ -237,6 +266,9 @@ class FiloHttpServer:
         grpc_peers = {} if local_dispatch else self.grpc_peers
         grpc_partitions = {} if local_dispatch else self.grpc_partitions
         return QueryPlanner(shards, backend=self.backend,
+                            deadline=deadline,
+                            allow_partial=allow_partial,
+                            resilience=self.resilience,
                             shard_mapper=self.shard_mapper,
                             mesh_executor=self.mesh_executor,
                             spread=self.spread,
@@ -257,6 +289,23 @@ class FiloHttpServer:
     def _param(qs, name, default=None):
         v = qs.get(name)
         return v[0] if v else default
+
+    @staticmethod
+    def _parse_duration_s(raw: Optional[str], default_s: float) -> float:
+        """&timeout= value: plain seconds or a Prometheus-style suffixed
+        duration (500ms / 30s / 2m / 1h). Bad values keep the default."""
+        if not raw:
+            return default_s
+        try:
+            m = re.match(r"^\s*([0-9.]+)\s*(ms|s|m|h)?\s*$", raw)
+            if not m:
+                return default_s
+            v = float(m.group(1))
+            scale = {"ms": 1e-3, "s": 1.0, "m": 60.0,
+                     "h": 3600.0}.get(m.group(2) or "s", 1.0)
+            return max(v * scale, 1e-3)
+        except ValueError:
+            return default_s
 
     def _query_range(self, engine, qs):
         import time as _time
@@ -288,8 +337,7 @@ class FiloHttpServer:
             "execMs": round((t3 - t2) * 1000, 3),
             "plan": type(ex).__name__,
         }
-        if engine.stats.warnings:
-            out["warnings"] = sorted(set(engine.stats.warnings))
+        prom_json.attach_degraded(out, res, engine.stats)
         return 200, out
 
     def _query_instant(self, engine, qs):
@@ -303,8 +351,7 @@ class FiloHttpServer:
             return 200, prom_json.scalar(res, instant=True)
         out = prom_json.vector(res)
         out["stats"] = self._query_stats(engine, res)
-        if engine.stats.warnings:
-            out["warnings"] = sorted(set(engine.stats.warnings))
+        prom_json.attach_degraded(out, res, engine.stats)
         return 200, out
 
     @staticmethod
@@ -606,7 +653,10 @@ class FiloHttpServer:
                                spread_provider=self.spread_provider,
                                limits=self.query_limits,
                                node_id=self.node_id, peers=self.peers,
-                               buddies=self.buddies, dataset=ds)
+                               buddies=self.buddies, dataset=ds,
+                               resilience=self.resilience,
+                               deadline=Deadline.after(
+                                   self.query_timeout_s))
         results = []
         for q in queries:
             # Prometheus clients send __name__; our index stores the

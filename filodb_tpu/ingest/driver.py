@@ -33,6 +33,7 @@ from typing import Callable, List, Optional
 from filodb_tpu.core.memstore import TimeSeriesShard
 from filodb_tpu.ingest.stream import IngestionStream
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+from filodb_tpu.testing import chaos
 
 
 class IngestionDriver:
@@ -126,6 +127,11 @@ class IngestionDriver:
         batch = self.stream.read(self.next_offset, max_records=limit)
         if not batch:
             return False
+        # chaos fault point: a failing stream consumer (the Kafka-poll
+        # failure analogue) — the driver thread's defensive handler
+        # flips the shard to ERROR, which tests assert on
+        chaos.fire("ingest.batch", shard=self.shard.shard_num,
+                   offset=self.next_offset)
         for sd in batch:
             self.shard.ingest(sd.container, sd.offset)
             self.next_offset = sd.offset + 1
@@ -145,6 +151,9 @@ class IngestionDriver:
             return
         group = self._next_group
         self._next_group = (self._next_group + 1) % self.shard.num_groups
+        # chaos fault point: a failing flush (ColumnStore write error)
+        chaos.fire("ingest.flush", shard=self.shard.shard_num,
+                   group=group)
         self.shard.flush_group(group, offset=self.next_offset - 1)
         if self.max_resident_samples:
             self.shard.ensure_headroom(self.max_resident_samples)

@@ -9,5 +9,13 @@ is an XLA collective (psum/pmax) over ICI instead of actor messages.
 
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
 from filodb_tpu.parallel.mesh import MeshExecutor, pack_sharded
+from filodb_tpu.parallel.resilience import (BreakerOpenError,
+                                            BreakerRegistry, CircuitBreaker,
+                                            Deadline, DeadlineExceeded,
+                                            PeerResilience, RetryPolicy,
+                                            TransportError)
 
-__all__ = ["ShardMapper", "ShardStatus", "MeshExecutor", "pack_sharded"]
+__all__ = ["ShardMapper", "ShardStatus", "MeshExecutor", "pack_sharded",
+           "RetryPolicy", "CircuitBreaker", "BreakerRegistry", "Deadline",
+           "PeerResilience", "TransportError", "BreakerOpenError",
+           "DeadlineExceeded"]

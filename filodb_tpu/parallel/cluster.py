@@ -28,8 +28,12 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
+                                            RetryPolicy, TransportError,
+                                            resilient_call)
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
 from filodb_tpu.query.model import QueryError, RawSeries
+from filodb_tpu.testing import chaos
 
 
 def _b64(arr: np.ndarray) -> str:
@@ -89,16 +93,30 @@ def wire_to_series(rows: Sequence[Dict]) -> List[RawSeries]:
 
 
 def _get_json(url_or_req, node_id: str, timeout_s: float) -> Dict:
-    """Fetch + parse a peer response, mapping transport and peer errors to
-    QueryError (shared by leaf dispatch and whole-query forwarding)."""
+    """Fetch + parse a peer response, mapping transport errors to
+    TransportError (retryable, breaker-counted) and peer application
+    errors to QueryError (shared by leaf dispatch and whole-query
+    forwarding)."""
+    url = getattr(url_or_req, "full_url", url_or_req)
     try:
+        chaos.fire("http.peer", node=node_id, url=url)
         with urllib.request.urlopen(url_or_req, timeout=timeout_s) as r:
             payload = json.loads(r.read())
-    except OSError as e:
-        raise QueryError(f"remote node {node_id} unreachable: {e}")
+    except (OSError, ValueError) as e:      # ValueError: garbled body
+        raise TransportError(f"remote node {node_id} unreachable: {e}")
     if payload.get("status") != "success":
         raise QueryError(f"remote node {node_id}: {payload.get('error')}")
     return payload
+
+
+def _drop_grpc_channel(addr: str) -> None:
+    """Close + evict a cached gRPC channel (peer died or moved ports);
+    no-op when grpc isn't installed or nothing is cached."""
+    try:
+        from filodb_tpu.grpcsvc.client import drop_channel
+        drop_channel(addr)
+    except Exception:
+        pass
 
 
 def filters_to_wire(filters: Sequence[ColumnFilter]) -> List[List[str]]:
@@ -114,11 +132,21 @@ class RemoteShardGroup:
 
     `select_raw_series` recognizes it and delegates the leaf data fetch to
     the peer's POST /api/v1/raw/{dataset} endpoint — the ActorPlanDispatcher
-    leaf-dispatch hop, over HTTP instead of Akka+Kryo."""
+    leaf-dispatch hop, over HTTP instead of Akka+Kryo.
+
+    Transport failures retry per ``retry`` within the ``deadline``
+    budget; consecutive failures trip the peer's circuit breaker in
+    ``breakers`` (keyed by base URL). With ``allow_partial`` the caller
+    (select_raw_series) drops this group from the result and records a
+    warning instead of failing the query."""
 
     def __init__(self, node_id: str, base_url: str, dataset: str,
                  shard_nums: Optional[Sequence[int]],
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerRegistry] = None,
+                 deadline: Optional[Deadline] = None,
+                 allow_partial: bool = False):
         self.node_id = node_id
         self.base_url = base_url.rstrip("/")
         self.dataset = dataset
@@ -126,8 +154,18 @@ class RemoteShardGroup:
         self.shard_nums = list(shard_nums) if shard_nums is not None \
             else None
         self.timeout_s = timeout_s
+        self.retry = retry
+        self.breakers = breakers
+        self.deadline = deadline
+        self.allow_partial = allow_partial
         # planner bookkeeping: a group covers many shard numbers
         self.shard_num = tuple(self.shard_nums or ())
+
+    def describe(self) -> str:
+        """Human-readable identity for partial-result warnings."""
+        sh = ("all" if self.shard_nums is None
+              else ",".join(map(str, self.shard_nums)))
+        return f"shards [{sh}] on {self.node_id}"
 
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],
@@ -138,10 +176,17 @@ class RemoteShardGroup:
             "column": column, "shards": self.shard_nums,
             "full": bool(full),
         }).encode()
-        req = urllib.request.Request(
-            f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,
-            headers={"Content-Type": "application/json"})
-        payload = _get_json(req, self.node_id, self.timeout_s)
+
+        def dial(timeout_s: float) -> Dict:
+            req = urllib.request.Request(
+                f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,
+                headers={"Content-Type": "application/json"})
+            return _get_json(req, self.node_id, timeout_s)
+
+        payload = resilient_call(
+            dial, key=self.base_url, node_id=self.node_id,
+            timeout_s=self.timeout_s, retry=self.retry,
+            breakers=self.breakers, deadline=self.deadline)
         return wire_to_series(payload["data"])
 
     # metadata plans are answered via the HTTP layer's peer fan-out, not
@@ -163,7 +208,10 @@ class PromQlRemoteExec:
     def __init__(self, query: str, start_ms: int, step_ms: int,
                  end_ms: int, node_id: str, base_url: str, dataset: str,
                  timeout_s: float = 60.0, stats=None,
-                 local_only: bool = True):
+                 local_only: bool = True,
+                 retry: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerRegistry] = None,
+                 deadline: Optional[Deadline] = None):
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -177,6 +225,9 @@ class PromQlRemoteExec:
         # cross-cluster federation lets the remote cluster plan freely
         # (MultiPartitionPlanner semantics)
         self.local_only = local_only
+        self.retry = retry
+        self.breakers = breakers
+        self.deadline = deadline
 
     def execute(self):
         import urllib.parse
@@ -198,7 +249,11 @@ class PromQlRemoteExec:
         qs["hist-wire"] = "1"
         url = (f"{self.base_url}/promql/{self.dataset}/api/v1/{path}?"
                + urllib.parse.urlencode(qs))
-        payload = _get_json(url, self.node_id, self.timeout_s)
+        payload = resilient_call(
+            lambda t: _get_json(url, self.node_id, t),
+            key=self.base_url, node_id=self.node_id,
+            timeout_s=self.timeout_s, retry=self.retry,
+            breakers=self.breakers, deadline=self.deadline)
         if self.stats is not None and "stats" in payload:
             self.stats.series_scanned += payload["stats"].get(
                 "seriesScanned", 0)
@@ -233,8 +288,12 @@ class PromQlRemoteExec:
             hv = np.stack([h if h is not None
                            else np.full((steps.size, nb), np.nan)
                            for h in hrows])
+        # a degraded peer answers with partial/warnings markers: carry
+        # them through so the entry node's response stays honest
         return GridResult(steps, keys, values, hist_values=hv,
-                          bucket_les=les if any_hist else None)
+                          bucket_les=les if any_hist else None,
+                          partial=bool(payload.get("partial")),
+                          warnings=list(payload.get("warnings") or ()))
 
     def plan_tree(self, indent: int = 0) -> str:
         return (" " * indent + f"PromQlRemoteExec(node={self.node_id}, "
@@ -369,11 +428,19 @@ class FailureDetector:
                 self._peer_down_view[node] = set(
                     body.get("down_peers") or ())
                 gport = body.get("grpc_port")
-                if gport and self.grpc_peer_sink is not None \
-                        and node not in self.grpc_peer_sink:
+                if gport and self.grpc_peer_sink is not None:
                     host = urllib.parse.urlparse(url).hostname \
                         or "127.0.0.1"
-                    self.grpc_peer_sink[node] = f"{host}:{int(gport)}"
+                    addr = f"{host}:{int(gport)}"
+                    old = self.grpc_peer_sink.get(node)
+                    if old != addr:
+                        # a restarted peer advertises a NEW ephemeral
+                        # port: re-point the sink and drop the cached
+                        # channel to the dead address, or every later
+                        # dial would keep hitting it (round-5 advisor)
+                        self.grpc_peer_sink[node] = addr
+                        if old is not None:
+                            _drop_grpc_channel(old)
                 if self._down[node]:
                     self._down[node] = False
                     self._down_since.pop(node, None)
@@ -411,6 +478,14 @@ class FailureDetector:
                     self._down_since[node] = time.monotonic()
                     for sh in self.shards_by_node.get(node, []):
                         self.mapper.update(sh, ShardStatus.DOWN, node)
+                    # forget the dead node's data-plane address: when it
+                    # returns (likely on a new ephemeral port) the sink
+                    # re-learns from its fresh health advertisement
+                    # instead of dialing the dead address forever
+                    if self.grpc_peer_sink is not None:
+                        old = self.grpc_peer_sink.pop(node, None)
+                        if old is not None:
+                            _drop_grpc_channel(old)
                 if (self._down[node] and self.reassign_grace_s is not None
                         and not self._reassigned.get(node, False)
                         and time.monotonic() - self._down_since[node]

@@ -35,6 +35,17 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
+# jax moved shard_map to the top level (and renamed check_rep -> check_vma)
+# after 0.4.x; accept either so the mesh executor runs on both
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:                                                   # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
 from filodb_tpu.query.model import RangeParams, RawSeries
 from filodb_tpu.query.tpu import (_GATHER_FUNCS, _TS_PAD, TpuBackend,
                                   _window_endpoint, _window_gather,
@@ -160,7 +171,7 @@ class MeshExecutor:
         def run(func, agg, num_groups, nsteps_local, w_bound, ts, vals,
                 lens, gids, w0s, w0e, step, scalar):
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                _shard_map, mesh=mesh,
                 in_specs=(P("shard", None, None), P("shard", None, None),
                           P("shard", None), P("shard", None),
                           P(), P(), P(), P()),
@@ -231,7 +242,7 @@ class MeshExecutor:
         def run(func, num_groups, k, bottom, nsteps_local, w_bound, ts,
                 vals, lens, gids, w0s, w0e, step, scalar):
             @functools.partial(
-                jax.shard_map, mesh=mesh,
+                _shard_map, mesh=mesh,
                 in_specs=(P("shard", None, None), P("shard", None, None),
                           P("shard", None), P("shard", None),
                           P(), P(), P(), P()),

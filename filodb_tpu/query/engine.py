@@ -61,7 +61,23 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     for shard in shards:
         fetch_raw = getattr(shard, "fetch_raw", None)
         if fetch_raw is not None:       # RemoteShardGroup: peer dispatch
-            got = fetch_raw(filters, start_ms, end_ms, column, full=full)
+            try:
+                got = fetch_raw(filters, start_ms, end_ms, column,
+                                full=full)
+            except QueryError as e:
+                # degraded mode: with allow_partial the lost shard group
+                # drops out of the result and the response carries a
+                # warning naming it; fail-fast (default) re-raises
+                if not getattr(shard, "allow_partial", False) \
+                        or stats is None:
+                    raise
+                desc = getattr(shard, "describe", None)
+                who = desc() if desc is not None else \
+                    f"node {getattr(shard, 'node_id', '?')}"
+                stats.partial = True
+                stats.warnings.append(
+                    f"partial result: {who} unavailable ({e})")
+                continue
             for s in got:
                 if stats is not None:
                     stats.series_scanned += 1

@@ -67,12 +67,20 @@ class GridResult:
 
     ``values`` is [num_series, num_steps] float64 (NaN = no sample — carries
     the reference's NaN/staleness semantics through the pipeline).
-    For histogram results, ``hist_values`` is [num_series, num_steps, nb]."""
+    For histogram results, ``hist_values`` is [num_series, num_steps, nb].
+
+    ``partial``/``warnings`` carry degraded-mode provenance (the
+    Thanos/M3 partial-response analogue): a result assembled while some
+    shard group was unreachable is flagged, and every aggregation /
+    concatenation / stitch step propagates the flag upward so the Prom
+    JSON edge can surface ``"partial": true`` + per-shard warnings."""
     steps: np.ndarray                       # int64 [num_steps] ms
     keys: List[Dict[str, str]]              # per-series labels
     values: np.ndarray                      # f64 [S, T]
     hist_values: Optional[np.ndarray] = None  # f64 [S, T, NB]
     bucket_les: Optional[np.ndarray] = None
+    partial: bool = False                   # some shard group missing
+    warnings: List[str] = field(default_factory=list)
 
     @property
     def num_series(self) -> int:
@@ -80,6 +88,16 @@ class GridResult:
 
     def is_hist(self) -> bool:
         return self.hist_values is not None
+
+    def absorb_degraded(self, *parts: "GridResult") -> "GridResult":
+        """Fold children's partial flags/warnings into this result
+        (returns self for chaining)."""
+        for p in parts:
+            if isinstance(p, GridResult):
+                self.partial = self.partial or p.partial
+                self.warnings.extend(w for w in p.warnings
+                                     if w not in self.warnings)
+        return self
 
     @staticmethod
     def empty(steps: np.ndarray) -> "GridResult":
@@ -102,12 +120,17 @@ class QueryStats:
     # partial-result notes surfaced in the Prometheus response's
     # `warnings` array (e.g. a shard still bootstrapping on its adopter)
     warnings: list = field(default_factory=list)
+    # True when a shard group was dropped from this result (breaker
+    # open / peer exhausted under allow_partial) — drives the response's
+    # top-level "partial": true
+    partial: bool = False
 
     def add(self, other: "QueryStats") -> None:
         self.series_scanned += other.series_scanned
         self.samples_scanned += other.samples_scanned
         self.result_bytes += other.result_bytes
         self.warnings.extend(other.warnings)
+        self.partial = self.partial or other.partial
 
 
 class QueryError(Exception):

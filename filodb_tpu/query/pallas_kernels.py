@@ -32,6 +32,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax dropped / moved the top-level enable_x64 context manager across
+# versions; resolve whichever this install provides
+if hasattr(jax, "enable_x64"):
+    _enable_x64 = jax.enable_x64
+else:                                                   # jax <= 0.4.x
+    from jax.experimental import enable_x64 as _enable_x64
+
 # int32 sentinel for padded samples: beyond any valid relative timestamp
 TR_PAD = np.int32(2**31 - 1)
 
@@ -110,8 +117,35 @@ GS_ALT = 2             # the nominal slot is always outside: use kc0-1/kl0+1
 _GS_DSPAN_MAX = 48     # dispatcher cap on window/step (merged-stream rows)
 
 import os as _os  # noqa: E402
+# dev-only ablation knob (noroll/noepi/nodot/lowdot). DELIBERATELY only
+# honored in interpret/debug mode: every ablation produces WRONG numbers
+# by design (they exist to isolate kernel-stage costs in benchmarks),
+# so a stray env var must never corrupt compiled production results.
 _GS_ABLATE = frozenset(
-    (_os.environ.get("GS_ABLATE") or "").split(","))  # dev-only knob
+    x for x in (_os.environ.get("GS_ABLATE") or "").split(",") if x)
+_GS_ABLATE_WARNED = False
+
+
+def _gs_ablate_active(interpret: bool) -> frozenset:
+    """Effective ablation set for one kernel build; logs LOUDLY when any
+    ablation is active and when a compiled-mode run ignores the knob."""
+    global _GS_ABLATE_WARNED
+    if not _GS_ABLATE:
+        return _GS_ABLATE
+    import logging
+    log = logging.getLogger(__name__)
+    if not interpret:
+        if not _GS_ABLATE_WARNED:
+            _GS_ABLATE_WARNED = True
+            log.warning(
+                "GS_ABLATE=%s ignored: ablations only apply in "
+                "interpret/debug mode (results would be wrong)",
+                ",".join(sorted(_GS_ABLATE)))
+        return frozenset()
+    log.warning("GS_ABLATE active (%s): group-sum kernel results are "
+                "INTENTIONALLY wrong (benchmark ablation mode)",
+                ",".join(sorted(_GS_ABLATE)))
+    return _GS_ABLATE
 
 
 def _gs_mlen(st: int, dspan: int) -> int:
@@ -121,7 +155,7 @@ def _gs_mlen(st: int, dspan: int) -> int:
 
 def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
                      lo_mode: int, exact_branch: bool, n_ttiles: int,
-                     mlen: int,
+                     mlen: int, ablate: frozenset,
                      params_ref, v_ref, base_ref, oh_ref,
                      sum_ref, cnt_ref, v_scr, sems):
     """Grid: (n_s,) sequential. params (SMEM, i32):
@@ -213,7 +247,7 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         # (plain dynamic_slice on vectors has no Mosaic lowering, and
         # NEGATIVE dynamic roll shifts mis-lower — rotate left by
         # `len - off` instead). Row i of R is permuted-G row g_m + i.
-        if "noroll" in _GS_ABLATE:
+        if "noroll" in ablate:
             R = v_scr[slot, 0]
         else:
             R = pltpu.roll(v_scr[slot, 0], shift=mlen - offm, axis=0)
@@ -223,7 +257,7 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
 
         def fam_view(idx, kf):
             full = v_scr[slot, idx, :_GS_TT + _GS_AL]
-            if "noroll" in _GS_ABLATE:
+            if "noroll" in ablate:
                 return full[:_GS_TT]
             g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
             off = g - pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
@@ -317,7 +351,7 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         factor = extrap / sampled
         if func == "rate":
             factor = factor / (window.astype(jnp.float32) * 1e-3)
-        if "noepi" in _GS_ABLATE:
+        if "noepi" in ablate:
             out = delta
         else:
             out = delta * factor
@@ -326,13 +360,13 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         okf = jnp.where(ok, jnp.float32(1.0), jnp.float32(0.0))
         oh = oh_ref[:]
         sl = pl.ds(ti * _GS_TT, _GS_TT)
-        if "nodot" in _GS_ABLATE:
+        if "nodot" in ablate:
             sum_ref[sl, :] += local[:, :16]
             cnt_ref[sl, :] += okf[:, :16]
             return
         # HIGHEST: the MXU's default bf16 input truncation would round
         # every rate to 8 mantissa bits (bf16(0.1) = 0.10009765625)
-        prec = (jax.lax.Precision.DEFAULT if "lowdot" in _GS_ABLATE
+        prec = (jax.lax.Precision.DEFAULT if "lowdot" in ablate
                 else jax.lax.Precision.HIGHEST)
         sum_ref[sl, :] += jnp.dot(local, oh,
                                   preferred_element_type=jnp.float32,
@@ -406,7 +440,8 @@ def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
 
     def body(params, v_p, base, onehot, *, _k=functools.partial(
             _groupsum_kernel, func, st, dspan, hi_mode, lo_mode,
-            bool(exact_branch), n_ttiles, mlen)):
+            bool(exact_branch), n_ttiles, mlen,
+            _gs_ablate_active(interpret))):
         def kern(params_ref, v_ref, base_ref, oh_ref,
                  sum_ref, cnt_ref, v_scr, sems):
             _k(params_ref, v_ref, base_ref[0], oh_ref,
@@ -421,7 +456,7 @@ def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
             interpret=interpret,
         )(params, v_p, base, onehot)
 
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         sums, cnts = body(params, v_p, base, onehot)
     return sums[:nsteps], cnts[:nsteps]
 
@@ -508,7 +543,7 @@ def window_extract(tr: jnp.ndarray, pay: jnp.ndarray,
                             memory_space=pltpu.VMEM)
     # trace the kernel in 32-bit mode: under jax_enable_x64, index-map and
     # literal constants become i64, which Mosaic cannot legalize
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         outs = pl.pallas_call(
             functools.partial(_extract_kernel, C),
             grid=grid,

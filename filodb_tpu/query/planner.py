@@ -246,13 +246,37 @@ class ConcatExec(ExecPlan):
     LocalPartitionDistConcatExec over pushed-down per-shard plans,
     exec/DistConcatExec.scala). Children evaluate disjoint series sets
     (each series lives on exactly one shard), so plain concatenation is
-    the correct union."""
+    the correct union.
+
+    Degraded mode: with ``allow_partial`` a child that fails with a
+    QueryError (peer exhausted, breaker open) is dropped and the result
+    is flagged partial with a warning naming the lost child; default
+    remains fail-fast. ``deadline`` is checked between children so an
+    exhausted budget stops the fan-out cleanly."""
     children: Sequence[ExecPlan]
     stats: QueryStats
+    allow_partial: bool = False
+    deadline: Optional[object] = None
 
     def execute(self):
         import numpy as np
-        outs = [c.execute() for c in self.children]
+        outs = []
+        dropped: List[str] = []
+        for c in self.children:
+            if self.deadline is not None:
+                self.deadline.check("ConcatExec fan-out")
+            try:
+                outs.append(c.execute())
+            except QueryError as e:
+                if not self.allow_partial:
+                    raise
+                who = c.plan_tree().strip()
+                dropped.append(f"partial result: {who} failed ({e})")
+        if not outs:
+            if dropped:
+                raise QueryError(
+                    "all shard groups failed: " + "; ".join(dropped))
+            raise QueryError("ConcatExec has no children")
         grids = [o for o in outs if isinstance(o, GridResult)]
         if not grids:
             return outs[0]
@@ -266,13 +290,30 @@ class ConcatExec(ExecPlan):
             hvs = [g.hist_values for g in grids
                    if g.hist_values is not None]
             nb = max(h.shape[2] for h in hvs)
+            # children must agree on the bucket scheme: the les of every
+            # child must be a prefix of the max-width child's, or the
+            # padded concat would silently mix incompatible buckets
+            les = max((g.bucket_les for g in grids
+                       if g.bucket_les is not None), key=len)
+            for g in grids:
+                gl = g.bucket_les
+                if gl is not None and not np.array_equal(
+                        np.asarray(gl), np.asarray(les)[:len(gl)]):
+                    raise QueryError(
+                        "cannot concatenate histogram results with "
+                        f"mismatched bucket schemes ({list(gl)} vs "
+                        f"{list(les)})")
             hv = np.concatenate(
                 [np.pad(h, ((0, 0), (0, 0), (0, nb - h.shape[2])),
                         constant_values=np.nan) for h in hvs], axis=0)
-            les = next(g.bucket_les for g in grids
-                       if g.bucket_les is not None)
-        return GridResult(steps, keys, vals, hist_values=hv,
-                          bucket_les=les)
+        out = GridResult(steps, keys, vals, hist_values=hv,
+                         bucket_les=les).absorb_degraded(*grids)
+        if dropped:
+            out.partial = True
+            out.warnings.extend(dropped)
+            self.stats.partial = True
+            self.stats.warnings.extend(dropped)
+        return out
 
     def plan_tree(self, indent: int = 0) -> str:
         pads = " " * indent
@@ -295,6 +336,13 @@ class LocalEngineExec(ExecPlan):
                           limits=self.limits)
         out = eng.execute(self.plan)
         self.stats.add(eng.stats)
+        if isinstance(out, GridResult) and eng.stats.partial:
+            # degraded leaf dispatch inside the engine (a shard group
+            # dropped under allow_partial): stamp the grid so every
+            # aggregation above carries the flag
+            out.partial = True
+            out.warnings.extend(w for w in eng.stats.warnings
+                                if w not in out.warnings)
         return out
 
     def plan_tree(self, indent: int = 0) -> str:
@@ -326,6 +374,7 @@ class MeshAggregateExec(ExecPlan):
     stats: QueryStats
     limits: Optional[QueryLimits] = None
     hist_les: Optional[np.ndarray] = None
+    deadline: Optional[object] = None
 
     def execute(self) -> GridResult:
         from filodb_tpu.query.engine import clip_series
@@ -336,6 +385,8 @@ class MeshAggregateExec(ExecPlan):
         # into the planner-lifetime counters
         qstats = QueryStats()
         for shard in self.shards:
+            if self.deadline is not None:
+                self.deadline.check("MeshAggregateExec data selection")
             row = select_raw_series(
                 [shard], self.raw.filters, self.raw.start_ms,
                 self.raw.end_ms, self.raw.column, qstats, full=True,
@@ -466,7 +517,7 @@ class StitchExec(ExecPlan):
             raise QueryError("stitch produced no grid results")
         if len(parts) == 1:
             return parts[0]
-        return stitch_grids(parts[0], parts[1])
+        return stitch_grids(parts[0], parts[1]).absorb_degraded(*parts)
 
     def plan_tree(self, indent: int = 0) -> str:
         pads = " " * indent
@@ -499,7 +550,10 @@ class QueryPlanner:
                  local_partitions: Optional[Sequence[str]] = None,
                  dataset: str = "timeseries",
                  grpc_peers: Optional[Dict[str, str]] = None,
-                 grpc_partitions: Optional[Dict[str, str]] = None):
+                 grpc_partitions: Optional[Dict[str, str]] = None,
+                 deadline: Optional[object] = None,
+                 allow_partial: bool = False,
+                 resilience: Optional[object] = None):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -541,7 +595,31 @@ class QueryPlanner:
         # (grpcsvc; PromQLGrpcServer.scala:44)
         self.grpc_peers = dict(grpc_peers or {})
         self.grpc_partitions = dict(grpc_partitions or {})
+        # degraded-mode execution (parallel/resilience.py): per-query
+        # deadline budget + opt-in partial results; the retry policy and
+        # breaker registry are server-lifetime (breaker state must
+        # outlive one query)
+        self.deadline = deadline
+        self.allow_partial = bool(allow_partial)
+        if resilience is None:
+            from filodb_tpu.parallel.resilience import PeerResilience
+            resilience = PeerResilience.default()
+        self.resilience = resilience
         self.stats = QueryStats()
+
+    def _remote_kw(self) -> Dict:
+        """Resilience kwargs shared by every remote shard group."""
+        return dict(retry=self.resilience.retry,
+                    breakers=self.resilience.breakers,
+                    deadline=self.deadline,
+                    allow_partial=self.allow_partial)
+
+    def _exec_kw(self) -> Dict:
+        """Resilience kwargs for whole-query remote exec nodes (partial
+        tolerance lives in the surrounding ConcatExec, not the hop)."""
+        return dict(retry=self.resilience.retry,
+                    breakers=self.resilience.breakers,
+                    deadline=self.deadline)
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
     def shards_from_filters(self, filters: Sequence[ColumnFilter]
@@ -640,7 +718,8 @@ class QueryPlanner:
                     by_buddy.setdefault(url, []).append(n)
             for i, (url, group) in enumerate(sorted(by_buddy.items())):
                 local.append(RemoteShardGroup(f"buddy:{url}", url,
-                                              self.dataset, group))
+                                              self.dataset, group,
+                                              **self._remote_kw()))
         if not self.peers or self.mapper is None:
             return local
         # group non-local shard numbers by their owning peer node
@@ -658,11 +737,14 @@ class QueryPlanner:
             gaddr = self.grpc_peers.get(node)
             if gaddr:
                 from filodb_tpu.grpcsvc import GrpcShardGroup
-                local.append(GrpcShardGroup(node, gaddr, self.dataset,
-                                            group))
+                local.append(GrpcShardGroup(
+                    node, gaddr, self.dataset, group,
+                    http_fallback=self.peers.get(node),
+                    **self._remote_kw()))
             else:
                 local.append(RemoteShardGroup(node, self.peers[node],
-                                              self.dataset, group))
+                                              self.dataset, group,
+                                              **self._remote_kw()))
         return local
 
     # -- materialization -------------------------------------------------
@@ -771,15 +853,20 @@ class QueryPlanner:
                 children.append(GrpcRemoteExec(
                     query, start, step, end, node, gaddr, self.dataset,
                     stats=self.stats, local_only=True,
-                    plan_wire=pw[0] if pw else b""))
+                    plan_wire=pw[0] if pw else b"",
+                    http_fallback=self.peers.get(node),
+                    **self._exec_kw()))
             elif node in self.peers:
                 from filodb_tpu.parallel.cluster import PromQlRemoteExec
                 children.append(PromQlRemoteExec(
                     query, start, step, end, node, self.peers[node],
-                    self.dataset, stats=self.stats, local_only=True))
+                    self.dataset, stats=self.stats, local_only=True,
+                    **self._exec_kw()))
             else:
                 return None
-        return ConcatExec(children, self.stats)
+        return ConcatExec(children, self.stats,
+                          allow_partial=self.allow_partial,
+                          deadline=self.deadline)
 
     def _try_remote_pushdown(self, plan) -> Optional[ExecPlan]:
         """Whole-query forwarding when EVERY pruned shard lives on ONE
@@ -811,17 +898,23 @@ class QueryPlanner:
                 return GrpcRemoteExec(
                     fw[0] if fw else f"<plan:{type(plan).__name__}>",
                     start, step, end, g.node_id, gaddr, g.dataset,
-                    stats=self.stats, plan_wire=wire_bytes)
+                    stats=self.stats, plan_wire=wire_bytes,
+                    http_fallback=(self.peers.get(g.node_id)
+                                   if fw else None),
+                    **self._exec_kw())
         if fw is None:
             return None
         query, start, step, end = fw
         if gaddr:
             from filodb_tpu.grpcsvc import GrpcRemoteExec
             return GrpcRemoteExec(query, start, step, end, g.node_id,
-                                  gaddr, g.dataset, stats=self.stats)
+                                  gaddr, g.dataset, stats=self.stats,
+                                  http_fallback=self.peers.get(g.node_id),
+                                  **self._exec_kw())
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end, g.node_id,
-                                g.base_url, g.dataset, stats=self.stats)
+                                g.base_url, g.dataset, stats=self.stats,
+                                **self._exec_kw())
 
     def _plan_wire_of(self, plan):
         """(wire_bytes, start, step, end) when the plan serializes
@@ -890,11 +983,13 @@ class QueryPlanner:
             return GrpcRemoteExec(query, start, step, end,
                                   f"partition:{gaddr}", gaddr,
                                   self.dataset, stats=self.stats,
-                                  local_only=False)
+                                  local_only=False, http_fallback=url,
+                                  **self._exec_kw())
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end,
                                 f"partition:{url}", url, self.dataset,
-                                stats=self.stats, local_only=False)
+                                stats=self.stats, local_only=False,
+                                **self._exec_kw())
 
     # -- raw/downsample tiering (LongTimeRangePlanner.scala:30) -----------
     def _earliest_raw_ms(self) -> int:
@@ -1041,7 +1136,8 @@ class QueryPlanner:
             offset_ms=inner.offset_ms,
             params=RangeParams(inner.start_ms, inner.step_ms, inner.end_ms),
             raw=raw, shards=shards, mesh_executor=self.mesh,
-            stats=self.stats, limits=self.limits, hist_les=hist_les)
+            stats=self.stats, limits=self.limits, hist_les=hist_les,
+            deadline=self.deadline)
 
     @staticmethod
     def _hist_selection(shards, raw: lp.RawSeriesPlan):

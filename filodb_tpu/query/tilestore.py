@@ -383,6 +383,13 @@ class AlignedTiles:
             # lowering): span2 <= 2^e with frexp's m in [0.5, 1)
             span2 = np.maximum(np.asarray(vmax - vmin) * 0.5, 2.0 ** -130)
             _, e = np.frexp(span2)
+            if np.any(60 - e < -96):
+                # a span this wide (> 2^156) cannot be represented in
+                # the 61-bit fixed-point channel at any in-range scale:
+                # clipping the exponent would silently WRAP int64 and
+                # corrupt results — take the exact f64 fallback instead
+                self._tperm[key] = (None,)
+                return None
             s_np = np.clip(60 - e, -96, 126).astype(np.int32)
             s = jnp.asarray(s_np)
             scale = jnp.asarray(np.ldexp(1.0, s_np))
@@ -1162,16 +1169,40 @@ def groupsum_counters(tiles: AlignedTiles, func: str, steps: np.ndarray,
                pk.GS_ALT if phase_e < -J else pk.GS_BOTH)
     lo_mode = (pk.GS_CUR if phase_s >= J else
                pk.GS_ALT if phase_s < -J else pk.GS_BOTH)
+    # full VMEM budget, not just the accumulators: the double-buffered
+    # DMA scratch (2 x nstreams x mlen x 3*SS i32) and the onehot/base
+    # input blocks also live in VMEM; an oversized query must fall back
+    # to the general path HERE, not explode at Mosaic compile time
+    nstreams = 1 + (1 if hi_mode != pk.GS_CUR and st != 1 else 0) \
+        + (1 if lo_mode != pk.GS_CUR and st != 1 else 0)
+    mlen = pk._gs_mlen(st, dspan)
+    vmem_bytes = (2 * T_pad * G * 4                      # sum/cnt accums
+                  + 2 * nstreams * mlen * 3 * pk._GS_SS * 4   # DMA scratch
+                  + pk._GS_SS * G * 4                    # onehot block
+                  + 8 * pk._GS_SS * 4)                   # base block
+    if vmem_bytes > 14 << 20:    # 16MB VMEM core minus compute headroom
+        return None
     S_pad = -(-S // pk._GS_SS) * pk._GS_SS
     v_p = tiles.t_perm_fixed_tiled(vch, st)
     base = tiles.t_fixed_base(vch)
     onehot = jnp.asarray(onehot, jnp.float32)
     if S_pad != S:
         onehot = jnp.pad(onehot, ((0, S_pad - S), (0, 0)))
-    return pk.counter_groupsum(
-        func, st, dspan, hi_mode, lo_mode, v_p, base, onehot,
-        k_l0, w0e - tiles.base_ms, window_ms, step, nsteps,
-        interpret=interpret)
+    try:
+        return pk.counter_groupsum(
+            func, st, dspan, hi_mode, lo_mode, v_p, base, onehot,
+            k_l0, w0e - tiles.base_ms, window_ms, step, nsteps,
+            interpret=interpret)
+    except Exception:
+        # backstop for shapes the budget model misses: a Mosaic
+        # compile/lowering failure downgrades to the general path
+        # instead of killing the query
+        import logging
+        logging.getLogger(__name__).warning(
+            "fused group-sum kernel failed to compile "
+            "(T=%d G=%d streams=%d); falling back to the general path",
+            nsteps, G, nstreams, exc_info=True)
+        return None
 
 
 import functools as _functools

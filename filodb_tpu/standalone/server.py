@@ -67,6 +67,17 @@ DEFAULTS = {
     # 0 = unlimited). Over-limit queries return HTTP 422.
     "query-sample-limit": 1_000_000,
     "query-series-limit": 100_000,
+    # degraded-mode execution (parallel/resilience.py): default per-query
+    # deadline budget (overridable per request via &timeout=), bounded
+    # retries on peer transport failures, and per-peer circuit breakers
+    # (open after N consecutive failures; half-open probe after the
+    # reset window). Partial responses stay opt-in per request
+    # (&allow_partial=true).
+    "query-timeout-s": 30.0,
+    "peer-retry-attempts": 3,
+    "peer-retry-base-delay-s": 0.05,
+    "breaker-failure-threshold": 3,
+    "breaker-reset-s": 5.0,
     # multi-process cluster (coordinator/v2 FiloDbClusterDiscovery.scala:50
     # ordinal->shards; explicit peer list like the akka-bootstrapper's
     # explicit-list mode): this node owns shards_for_ordinal(node-ordinal);
@@ -269,6 +280,20 @@ class FiloServer:
         peers = {k: v for k, v in
                  dict(self.config.get("peers") or {}).items()
                  if k != self.node_id}
+        from filodb_tpu.parallel.resilience import (BreakerRegistry,
+                                                    PeerResilience,
+                                                    RetryPolicy)
+        resilience = PeerResilience(
+            retry=RetryPolicy(
+                max_attempts=int(self.config.get(
+                    "peer-retry-attempts", 3)),
+                base_delay_s=float(self.config.get(
+                    "peer-retry-base-delay-s", 0.05))),
+            breakers=BreakerRegistry(
+                failure_threshold=int(self.config.get(
+                    "breaker-failure-threshold", 3)),
+                reset_timeout_s=float(self.config.get(
+                    "breaker-reset-s", 5.0))))
         self.http = FiloHttpServer(
             {self.ref.dataset: self.store.shards(self.ref)},
             backend=self.backend, shard_mapper=self.mapper,
@@ -290,7 +315,10 @@ class FiloServer:
                 self.config.get("grpc-peers") or {}).items()
                 if k != self.node_id},
             grpc_partitions=dict(
-                self.config.get("grpc-partitions") or {}))
+                self.config.get("grpc-partitions") or {}),
+            query_timeout_s=float(self.config.get("query-timeout-s",
+                                                  30.0)),
+            resilience=resilience)
         self.http.start()
         self.grpc_server = None
         if self.config.get("grpc-port") is not None:

@@ -1,0 +1,4 @@
+"""Test-support subsystems shipped with the package (chaos injection)."""
+
+from filodb_tpu.testing.chaos import (ChaosError, ChaosInjector,  # noqa: F401
+                                      fire, install, installed, uninstall)

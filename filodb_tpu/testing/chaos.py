@@ -1,0 +1,166 @@
+"""Chaos-injection harness: drop / delay / error RPCs at named fault
+points inside the cluster plane.
+
+The production code calls ``chaos.fire("point", key=value, ...)`` at its
+fault points; with no injector installed this is a single attribute read
+and return (safe to leave in hot-ish control paths). Tests install an
+injector EXPLICITLY — there is deliberately no env-var switch, so a
+production deployment can never trip faults by inherited environment
+(the reference gets the same effect from Akka's TestKit-only failure
+injectors living in src/test).
+
+Fault points wired in this build:
+
+  * ``grpc.call``     — grpcsvc/client.py before every stub dial
+                        (ctx: node, addr, method)
+  * ``http.peer``     — parallel/cluster.py before every peer HTTP fetch
+                        (ctx: node, url)
+  * ``ingest.batch``  — ingest/driver.py before a stream batch is
+                        applied (ctx: shard, offset)
+  * ``ingest.flush``  — ingest/driver.py before a group flush
+                        (ctx: shard, group)
+
+Usage:
+
+    inj = ChaosInjector()
+    inj.fail("grpc.call", times=2, match=lambda c: c["node"] == "node1")
+    inj.delay("http.peer", 0.5)
+    with inj:                      # or chaos.install(inj) / uninstall()
+        ... run the scenario ...
+    assert inj.fired("grpc.call") == 2
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+class ChaosError(ConnectionError):
+    """Default injected fault. Subclasses ConnectionError (an OSError)
+    so the HTTP peer path maps it to TransportError exactly like a real
+    refused/reset connection."""
+
+
+@dataclass
+class _Rule:
+    kind: str                              # "error" | "delay" | "drop"
+    match: Optional[Callable[[Dict], bool]] = None
+    times: Optional[int] = None            # None = every matching fire
+    exc: Optional[Callable[[], BaseException]] = None
+    delay_s: float = 0.0
+    hits: int = 0
+    field_lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def applies(self, ctx: Dict) -> bool:
+        if self.match is not None and not self.match(ctx):
+            return False
+        with self.field_lock:
+            if self.times is not None and self.hits >= self.times:
+                return False
+            self.hits += 1
+            return True
+
+
+class ChaosInjector:
+    """Holds fault rules per point and a log of every fire."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: Dict[str, List[_Rule]] = {}
+        self._fired: Dict[str, int] = {}
+        self.log: List[Dict] = []
+
+    # -- rule builders -----------------------------------------------------
+    def fail(self, point: str,
+             exc: Optional[Callable[[], BaseException]] = None,
+             times: Optional[int] = None,
+             match: Optional[Callable[[Dict], bool]] = None
+             ) -> "ChaosInjector":
+        """Raise at ``point`` (default: ChaosError, a ConnectionError)."""
+        self._add(point, _Rule("error", match, times,
+                               exc or (lambda: ChaosError(
+                                   f"chaos: injected fault at {point}"))))
+        return self
+
+    def drop(self, point: str, times: Optional[int] = None,
+             match: Optional[Callable[[Dict], bool]] = None
+             ) -> "ChaosInjector":
+        """Black-hole the call: a long stall then transport error — the
+        'packets dropped, TCP timeout' shape (distinct from fail()'s
+        instant connection-refused)."""
+        self._add(point, _Rule("drop", match, times))
+        return self
+
+    def delay(self, point: str, delay_s: float,
+              times: Optional[int] = None,
+              match: Optional[Callable[[Dict], bool]] = None
+              ) -> "ChaosInjector":
+        self._add(point, _Rule("delay", match, times, delay_s=delay_s))
+        return self
+
+    def _add(self, point: str, rule: _Rule) -> None:
+        with self._lock:
+            self._rules.setdefault(point, []).append(rule)
+
+    # -- introspection -----------------------------------------------------
+    def fired(self, point: str) -> int:
+        """How many times ``point`` was REACHED (whether or not a rule
+        triggered) — lets tests assert 'no further dials' after a
+        breaker opens."""
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    # -- the hot hook ------------------------------------------------------
+    def on_fire(self, point: str, ctx: Dict) -> None:
+        with self._lock:
+            self._fired[point] = self._fired.get(point, 0) + 1
+            self.log.append({"point": point, **ctx})
+            rules = list(self._rules.get(point, ()))
+        for rule in rules:
+            if not rule.applies(ctx):
+                continue
+            if rule.kind == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.kind == "drop":
+                # bounded stall standing in for a TCP timeout: long
+                # enough that an un-deadlined caller visibly hangs,
+                # short enough for test suites
+                time.sleep(rule.delay_s or 2.0)
+                raise ChaosError(f"chaos: dropped call at {point}")
+            else:
+                raise rule.exc()
+
+    def __enter__(self) -> "ChaosInjector":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+_installed: Optional[ChaosInjector] = None
+
+
+def install(injector: ChaosInjector) -> ChaosInjector:
+    global _installed
+    _installed = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _installed
+    _installed = None
+
+
+def installed() -> Optional[ChaosInjector]:
+    return _installed
+
+
+def fire(point: str, **ctx) -> None:
+    """Production-side hook: no-op unless an injector is installed."""
+    inj = _installed
+    if inj is not None:
+        inj.on_fire(point, ctx)

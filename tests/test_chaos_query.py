@@ -1,0 +1,325 @@
+"""Chaos scenarios over the distributed query path (testing/chaos.py +
+parallel/resilience.py): peer death mid-query with opt-in partial
+results, fail-fast default within the deadline budget, circuit breaker
+open/recover, gRPC->HTTP fallback, peer restart on a new ephemeral port
+(sink re-discovery), and ingest-path fault injection.
+
+(The reference covers this ground with Akka multi-jvm kill tests +
+queryActorsCircuitBreaker config; the partial-response semantics follow
+Thanos/M3 federation behavior.)"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.parallel.resilience import (BreakerRegistry, RetryPolicy)
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+N_SAMPLES = 60
+N_INSTANCES = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    try:
+        with urllib.request.urlopen(url, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _query(port, **extra):
+    """Unpruned cross-node range query entering the given node."""
+    return _get(port, "/promql/timeseries/api/v1/query_range",
+                query='rate({_metric_=~'
+                      '"heap_usage|http_requests_total"}[5m])',
+                start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60,
+                **extra)
+
+
+def _instances(body):
+    """Full per-series identity set (series are spread across BOTH
+    nodes, so losing one node strictly shrinks this set)."""
+    return {tuple(sorted(r["metric"].items()))
+            for r in body["data"]["result"]}
+
+
+@pytest.fixture
+def cluster():
+    """Two in-process nodes, half the shards each. The failure detector
+    polls so slowly it never flips shards DOWN during a test — the
+    exec-layer resilience (retries/breakers/partials) is what's under
+    test, i.e. the window BEFORE detection reacts."""
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": 300.0,
+        "grpc-port": None,                  # deterministic HTTP plane
+        "query-timeout-s": 8.0,
+        "peer-retry-attempts": 1,           # breaker math: 1 dial/query
+        "peer-retry-base-delay-s": 0.01,
+        "breaker-failure-threshold": 3,
+        "breaker-reset-s": 0.3,
+    }
+    cfg0 = {**base, "node-ordinal": 0, "port": p0}
+    cfg1 = {**base, "node-ordinal": 1, "port": p1}
+    a = FiloServer(cfg0).start()
+    a.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    b = FiloServer(cfg1).start()
+    b.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    try:
+        yield a, b, cfg1
+    finally:
+        chaos.uninstall()
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+
+def test_peer_death_mid_query_partial_vs_failfast(cluster):
+    a, b, _ = cluster
+    code, full = _query(a.port)
+    assert code == 200 and "partial" not in full
+    all_instances = _instances(full)
+    assert len(all_instances) >= N_INSTANCES
+
+    # node1 "dies" mid-query: every leaf fetch to it fails at the fault
+    # point (connection-refused shape), while the shard mapper still
+    # believes its shards are ACTIVE (detection hasn't reacted yet)
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+    with inj:
+        # default: fail-fast with a clean query error, quickly
+        t0 = time.monotonic()
+        code, err = _query(a.port)
+        elapsed = time.monotonic() - t0
+        assert code in (400, 503)
+        assert err["status"] == "error"
+        assert "node1" in err["error"]
+        assert elapsed < 8.0                # no flat-60s hang
+
+        # opt-in: the surviving shards answer, flagged partial with a
+        # warning naming the lost shard group
+        code, body = _query(a.port, allow_partial="true")
+        assert code == 200
+        assert body.get("partial") is True
+        assert any("node1" in w for w in body["warnings"])
+        got = _instances(body)
+        assert got and got < all_instances  # strict subset survived
+    # chaos removed: full results return
+    code, again = _query(a.port)
+    assert code == 200 and _instances(again) == all_instances
+
+
+def test_breaker_opens_stops_dialing_and_recovers(cluster):
+    a, b, cfg1 = cluster
+    _, full = _query(a.port)
+    all_instances = _instances(full)
+    b.stop()                                # peer really dies
+
+    # threshold=3, one dial per query: three failing queries open it
+    for _ in range(3):
+        code, body = _query(a.port, allow_partial="true")
+        assert code == 200 and body.get("partial") is True
+    breaker = a.http.resilience.breakers.get(
+        f"http://127.0.0.1:{b.port}")
+    assert breaker.state == "open"
+
+    # open breaker: served partial WITHOUT dialing the dead peer
+    counter = chaos.ChaosInjector()         # counting only, no rules
+    with counter:
+        code, body = _query(a.port, allow_partial="true")
+    assert code == 200 and body.get("partial") is True
+    assert counter.fired("http.peer") == 0  # no further dials
+    assert any("circuit breaker is open" in w for w in body["warnings"])
+
+    # peer returns on the SAME port; after the reset window the
+    # half-open probe closes the breaker and results are whole again
+    b2 = FiloServer(cfg1).start()
+    b2.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                     start_ms=T0 * 1000)
+    try:
+        time.sleep(0.35)                    # past breaker-reset-s
+        deadline = time.monotonic() + 10
+        got = set()
+        while time.monotonic() < deadline:
+            code, body = _query(a.port, allow_partial="true")
+            got = _instances(body)
+            if code == 200 and got == all_instances \
+                    and "partial" not in body:
+                break
+            time.sleep(0.1)
+        assert got == all_instances
+        assert breaker.state == "closed"
+    finally:
+        b2.stop()
+
+
+def test_blackhole_peer_deadline_budget(cluster):
+    """A peer that accepts but never answers (packets dropped) must not
+    hang the query: the per-hop timeout is the REMAINING deadline
+    budget, and the failure surfaces as a clean error."""
+    a, b, _ = cluster
+    inj = chaos.ChaosInjector()
+    inj.drop("http.peer", match=lambda c: c.get("node") == "node1")
+    with inj:
+        t0 = time.monotonic()
+        code, err = _query(a.port, timeout="1s")
+        elapsed = time.monotonic() - t0
+    assert code in (400, 503)
+    assert err["status"] == "error"
+    assert elapsed < 8.0                    # stall (2s) + overhead << 60s
+
+
+def test_partial_instant_query_shape(cluster):
+    a, b, _ = cluster
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+    with inj:
+        code, body = _get(
+            a.port, "/promql/timeseries/api/v1/query",
+            query='{_metric_=~"heap_usage|http_requests_total"}',
+            time=T0 + (N_SAMPLES - 1) * 10, allow_partial="true")
+    assert code == 200
+    assert body.get("partial") is True
+    assert any("node1" in w for w in body["warnings"])
+
+
+def test_grpc_plane_falls_back_to_http(cluster):
+    """gRPC transport failure downgrades leaf dispatch to the HTTP
+    control plane instead of failing the query."""
+    pytest.importorskip("grpc")
+    from filodb_tpu.core.index import ColumnFilter
+    from filodb_tpu.grpcsvc.client import GrpcShardGroup
+    a, b, _ = cluster
+    g = GrpcShardGroup(
+        "node1", f"127.0.0.1:{_free_port()}",   # nothing listens here
+        "timeseries", None, timeout_s=5.0,
+        retry=RetryPolicy(max_attempts=1, base_delay_s=0.0),
+        breakers=BreakerRegistry(failure_threshold=99),
+        http_fallback=f"http://127.0.0.1:{b.port}")
+    series = g.fetch_raw([ColumnFilter("_metric_", "eq", "heap_usage")],
+                         T0 * 1000, (T0 + N_SAMPLES * 10) * 1000, None)
+    assert len(series) > 0                  # served via the HTTP plane
+
+
+def test_peer_restart_new_port_updates_grpc_sink():
+    """FailureDetector re-points grpc_peer_sink when a peer advertises a
+    different host:port, and forgets it while the peer is down (advisor:
+    restarted peers were dialed at their dead address forever)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from filodb_tpu.parallel.cluster import FailureDetector
+    from filodb_tpu.parallel.shardmapper import ShardMapper
+
+    adv = {"grpc_port": 7001, "healthy": True}
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if not adv["healthy"]:
+                self.send_error(500)
+                return
+            body = json.dumps({"status": "healthy", "shards": {},
+                               "down_peers": [],
+                               "grpc_port": adv["grpc_port"]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}"
+    mapper = ShardMapper(2)
+    mapper.assign(0, "node1")
+    mapper.assign(1, "node1")
+    sink = {}
+    det = FailureDetector(mapper, {"node1": url}, {"node1": [0, 1]},
+                          threshold=2, timeout_s=2.0,
+                          grpc_peer_sink=sink)
+    try:
+        det.poll_once()
+        assert sink == {"node1": "127.0.0.1:7001"}
+        # restart on a new ephemeral port: advertisement changes
+        adv["grpc_port"] = 7002
+        det.poll_once()
+        assert sink == {"node1": "127.0.0.1:7002"}
+        # peer down: the sink entry is dropped, not kept stale
+        httpd.shutdown()
+        httpd.server_close()
+        det.poll_once()
+        det.poll_once()
+        assert det.is_down("node1")
+        assert "node1" not in sink
+    finally:
+        try:
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_ingest_chaos_flips_shard_to_error():
+    """A failing stream consumer (the Kafka-poll failure analogue) is
+    surfaced as shard ERROR status instead of a silent dead thread; the
+    driver intentionally re-raises after flipping the status."""
+    from filodb_tpu.core.memstore import TimeSeriesShard
+    from filodb_tpu.core.record import RecordBuilder
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+    from filodb_tpu.ingest import IngestionDriver, MemoryIngestionStream
+    from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+
+    stream = MemoryIngestionStream()
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    b.add_sample("prom-counter",
+                 {"_metric_": "reqs_total", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": "i0"},
+                 T0 * 1000, 1.0)
+    for c in b.containers():
+        stream.append(c)
+    mapper = ShardMapper(1)
+    shard = TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, 0,
+                            num_groups=2)
+    inj = chaos.ChaosInjector().fail("ingest.batch", times=1)
+    with inj:
+        drv = IngestionDriver(shard, stream, mapper=mapper,
+                              poll_interval_s=0.01).start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if mapper.status(0) is ShardStatus.ERROR:
+                break
+            time.sleep(0.02)
+        assert mapper.status(0) is ShardStatus.ERROR
+        drv.stop(flush=False)
+    assert inj.fired("ingest.batch") == 1

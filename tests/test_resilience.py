@@ -1,0 +1,237 @@
+"""Unit tests for the degraded-mode primitives (parallel/resilience.py)
+and the chaos-injection harness (testing/chaos.py)."""
+
+import time
+
+import pytest
+
+from filodb_tpu.parallel.resilience import (BreakerOpenError,
+                                            BreakerRegistry, CircuitBreaker,
+                                            Deadline, DeadlineExceeded,
+                                            RetryPolicy, TransportError,
+                                            resilient_call)
+from filodb_tpu.testing import chaos
+
+
+# -- Deadline --------------------------------------------------------------
+
+def test_deadline_remaining_and_clip():
+    t = [100.0]
+    d = Deadline(10.0, clock=lambda: t[0])
+    assert d.remaining() == pytest.approx(10.0)
+    assert d.clip(60.0) == pytest.approx(10.0)   # budget below flat 60s
+    assert d.clip(5.0) == pytest.approx(5.0)     # flat below budget
+    t[0] = 105.0
+    assert d.remaining() == pytest.approx(5.0)
+    assert not d.expired
+    t[0] = 111.0
+    assert d.expired
+    with pytest.raises(DeadlineExceeded):
+        d.check("unit test")
+    with pytest.raises(DeadlineExceeded):
+        d.clip(60.0)
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+def test_retry_backoff_grows_and_caps():
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.35,
+                    multiplier=2.0, jitter=0.0)
+    delays = [p.delay_s(a, rng=lambda: 0.0) for a in (1, 2, 3, 4)]
+    assert delays == pytest.approx([0.1, 0.2, 0.35, 0.35])
+    # full jitter shrinks, never grows
+    assert RetryPolicy(jitter=0.5).delay_s(1, rng=lambda: 1.0) \
+        < RetryPolicy(jitter=0.5).delay_s(1, rng=lambda: 0.0)
+
+
+# -- CircuitBreaker --------------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_half_open_recovers():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                      clock=lambda: t[0])
+    assert b.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+    b.record_failure()                       # third consecutive: open
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    t[0] = 4.9
+    assert not b.allow()                     # still inside the window
+    t[0] = 5.1
+    assert b.allow()                         # half-open probe claimed
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.allow()                     # only ONE probe in flight
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+
+
+def test_breaker_halfopen_failure_reopens():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                      clock=lambda: t[0])
+    b.record_failure()
+    assert b.state == CircuitBreaker.OPEN
+    t[0] = 6.0
+    assert b.allow()
+    b.record_failure()                       # probe failed: re-open
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()
+    t[0] = 11.5
+    assert b.allow()                         # another full window later
+
+
+def test_success_resets_consecutive_failure_count():
+    b = CircuitBreaker(failure_threshold=3)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED  # never 3 CONSECUTIVE
+
+
+# -- resilient_call --------------------------------------------------------
+
+def test_resilient_call_retries_then_succeeds():
+    calls = []
+
+    def flaky(timeout_s):
+        calls.append(timeout_s)
+        if len(calls) < 3:
+            raise TransportError("nope")
+        return "ok"
+
+    out = resilient_call(
+        flaky, key="k1", node_id="n", timeout_s=60.0,
+        retry=RetryPolicy(max_attempts=3, base_delay_s=0.0),
+        breakers=BreakerRegistry(failure_threshold=10), sleep=lambda s: None)
+    assert out == "ok" and len(calls) == 3
+
+
+def test_resilient_call_exhausts_and_raises():
+    reg = BreakerRegistry(failure_threshold=10)
+    with pytest.raises(TransportError):
+        resilient_call(
+            lambda t: (_ for _ in ()).throw(TransportError("down")),
+            key="k2", node_id="n", timeout_s=60.0,
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.0),
+            breakers=reg, sleep=lambda s: None)
+
+
+def test_resilient_call_does_not_dial_open_breaker():
+    reg = BreakerRegistry(failure_threshold=2, reset_timeout_s=60.0)
+    calls = []
+
+    def down(timeout_s):
+        calls.append(1)
+        raise TransportError("down")
+
+    for _ in range(2):
+        with pytest.raises((TransportError, BreakerOpenError)):
+            resilient_call(down, key="k3", node_id="n", timeout_s=1.0,
+                           retry=RetryPolicy(max_attempts=1),
+                           breakers=reg, sleep=lambda s: None)
+    n_before = len(calls)
+    with pytest.raises(BreakerOpenError):
+        resilient_call(down, key="k3", node_id="n", timeout_s=1.0,
+                       retry=RetryPolicy(max_attempts=1),
+                       breakers=reg, sleep=lambda s: None)
+    assert len(calls) == n_before            # breaker open: NO dial
+
+
+def test_resilient_call_application_error_not_retried():
+    from filodb_tpu.query.model import QueryError
+    calls = []
+
+    def answered(timeout_s):
+        calls.append(1)
+        raise QueryError("bad query")        # peer ANSWERED with an error
+
+    with pytest.raises(QueryError):
+        resilient_call(answered, key="k4", node_id="n", timeout_s=1.0,
+                       retry=RetryPolicy(max_attempts=5),
+                       breakers=BreakerRegistry(), sleep=lambda s: None)
+    assert len(calls) == 1
+    # and it did not count against the breaker
+    assert BreakerRegistry().get("k4").state == CircuitBreaker.CLOSED
+
+
+def test_application_error_closes_half_open_breaker():
+    """A peer that ANSWERS an error through a half-open probe proves the
+    transport recovered: the breaker must close, not jam half-open."""
+    from filodb_tpu.query.model import QueryError
+    reg = BreakerRegistry(failure_threshold=1, reset_timeout_s=0.05)
+    with pytest.raises(TransportError):
+        resilient_call(
+            lambda t: (_ for _ in ()).throw(TransportError("down")),
+            key="k6", node_id="n", timeout_s=1.0,
+            retry=RetryPolicy(max_attempts=1), breakers=reg,
+            sleep=lambda s: None)
+    assert reg.get("k6").state == CircuitBreaker.OPEN
+    time.sleep(0.06)
+    with pytest.raises(QueryError):
+        resilient_call(
+            lambda t: (_ for _ in ()).throw(QueryError("bad query")),
+            key="k6", node_id="n", timeout_s=1.0,
+            retry=RetryPolicy(max_attempts=1), breakers=reg,
+            sleep=lambda s: None)
+    assert reg.get("k6").state == CircuitBreaker.CLOSED
+
+
+def test_resilient_call_respects_deadline():
+    t = [0.0]
+    d = Deadline(5.0, clock=lambda: t[0])
+
+    def down(timeout_s):
+        # per-attempt timeout is clipped to the remaining budget
+        assert timeout_s <= 5.0
+        t[0] += 3.0                          # each attempt burns 3s
+        raise TransportError("slow death")
+
+    with pytest.raises((TransportError, DeadlineExceeded)):
+        resilient_call(down, key="k5", node_id="n", timeout_s=60.0,
+                       retry=RetryPolicy(max_attempts=10,
+                                         base_delay_s=0.0),
+                       breakers=BreakerRegistry(failure_threshold=99),
+                       deadline=d, sleep=lambda s: None)
+    assert t[0] <= 6.1                       # ~2 attempts, never 10
+
+
+# -- chaos harness ---------------------------------------------------------
+
+def test_chaos_noop_when_not_installed():
+    chaos.fire("grpc.call", node="x")        # must not raise
+
+
+def test_chaos_fail_rule_counts_and_disarms():
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", times=2,
+             match=lambda c: c.get("node") == "node1")
+    with inj:
+        with pytest.raises(chaos.ChaosError):
+            chaos.fire("http.peer", node="node1")
+        chaos.fire("http.peer", node="node0")    # no match: clean
+        with pytest.raises(chaos.ChaosError):
+            chaos.fire("http.peer", node="node1")
+        chaos.fire("http.peer", node="node1")    # rule exhausted
+    assert chaos.installed() is None
+    assert inj.fired("http.peer") == 4
+    assert [e["node"] for e in inj.log] == ["node1", "node0", "node1",
+                                            "node1"]
+
+
+def test_chaos_delay_rule():
+    inj = chaos.ChaosInjector().delay("grpc.call", 0.05, times=1)
+    t0 = time.monotonic()
+    with inj:
+        chaos.fire("grpc.call")
+        chaos.fire("grpc.call")              # only the first delays
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_chaos_error_is_oserror():
+    # http.peer maps OSError -> TransportError; the injected fault must
+    # ride the same path as a real refused connection
+    assert issubclass(chaos.ChaosError, OSError)
