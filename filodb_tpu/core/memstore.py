@@ -36,6 +36,7 @@ import numpy as np
 
 from filodb_tpu.core.index import (END_TIME_INGESTING, ColumnFilter, TagIndex)
 from filodb_tpu.core.record import PartKey, RecordContainer
+from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.core.schemas import (ColumnType, DataSchema, DatasetRef,
                                      Schemas)
 from filodb_tpu.memory import histogram as bh
@@ -68,6 +69,10 @@ def _is_hist(buf: bytes) -> bool:
     return buf[:1] in (bytes([bh.K_HIST_2D]), bytes([bh.K_HIST_SECT]))
 
 
+# the caches are shared by concurrent HTTP query threads; the chunk list
+# itself is append-only and read via snapshots, so only the caches (and
+# the publish step in switch_buffers) ride the lock
+@guarded_by("_cache_lock", "_decode_cache", "_merge_cache")
 class TimeSeriesPartition:
     """One time series in one shard (memstore/TimeSeriesPartition.scala:64).
 
@@ -369,10 +374,16 @@ class TimeSeriesPartition:
             n_chunks, (cts, cvals) = \
                 self._decoded_chunk_arrays_locked(col_index, col)
             buf_ts, buf_cols = self.buffer_snapshot()
+            # merge-cache bookkeeping stays under the same acquisition:
+            # a concurrent reader's pop must never race this thread's
+            # get/set on the shared dict (graftlint lock-guarded-access)
+            if not buf_ts.size:
+                self._merge_cache.pop(col_index, None)
+                cached = None
+            else:
+                cached = self._merge_cache.get(col_index)
         if not buf_ts.size:
-            self._merge_cache.pop(col_index, None)
             return cts, cvals, cts.size
-        cached = self._merge_cache.get(col_index)
         if cached is not None and cached[0] == n_chunks \
                 and cached[1] == buf_ts.size:
             return cached[2], cached[3], cts.size
@@ -392,7 +403,9 @@ class TimeSeriesPartition:
         mvals = np.concatenate([cvals, tail], axis=0)
         mts.setflags(write=False)
         mvals.setflags(write=False)
-        self._merge_cache[col_index] = (n_chunks, buf_ts.size, mts, mvals)
+        with self._cache_lock:
+            self._merge_cache[col_index] = (n_chunks, buf_ts.size,
+                                            mts, mvals)
         return mts, mvals, cts.size
 
     def hist_drop_rows(self, col_index: int) -> np.ndarray:

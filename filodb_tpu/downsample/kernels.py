@@ -28,7 +28,39 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from filodb_tpu.lint.contracts import kernel_contract
 
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _ds_example(extra_statics, S=8, N=64):
+    args = (_sds((S, N), jnp.int64), _sds((S, N), jnp.float64),
+            _sds((S,), jnp.int32), _sds((), jnp.int64),
+            _sds((), jnp.int64))
+    return args, dict(extra_statics)
+
+
+def _six_expect(S, P):
+    """The (sum, count, min, max, last_v, last_ts) output family."""
+    def expect(out):
+        shapes = [tuple(o.shape) for o in out]
+        if shapes != [(S, P)] * 6:
+            return f"outputs {shapes} != 6x({S}, {P})"
+        if str(out[-1].dtype) != "int64" \
+                or any(str(o.dtype) != "float64" for o in out[:5]):
+            return "dtypes != (5x f64, i64)"
+        return None
+    return expect
+
+
+@kernel_contract(
+    "downsample_gauge", kind="jit",
+    example=lambda: _ds_example({"nperiods": 16, "w_bound": 8}),
+    expect=_six_expect(8, 16),
+    notes="general gather path: [S, P, W] bounded gather for order "
+          "statistics, prefix sums for sum/count; W static")
 @functools.partial(jax.jit, static_argnames=("nperiods", "w_bound"))
 def downsample_gauge_tiles(ts, vals, lens, base, res, nperiods: int,
                            w_bound: int = 64):
@@ -101,6 +133,13 @@ def cascade_gauge(prev, base, res, nperiods: int, w_bound: int):
     return (s_out[0], counts, m_out[2], x_out[3], l_out[4], s_out[5])
 
 
+@kernel_contract(
+    "counter_emit_mask", kind="jit",
+    example=lambda: _ds_example({"nperiods": 16}),
+    expect=lambda out: None if tuple(out.shape) == (8, 64)
+    and str(out.dtype) == "bool" else f"mask {out.shape}/{out.dtype}",
+    notes="pure lane arithmetic (no scatter): last-of-period + both "
+          "sides of every counter reset")
 @functools.partial(jax.jit, static_argnames=("nperiods",))
 def counter_emit_mask(ts, vals, lens, base, res, nperiods: int):
     """Emit mask for counter downsampling: keep the LAST sample of every
@@ -147,6 +186,15 @@ def counter_emit_mask(ts, vals, lens, base, res, nperiods: int):
 # batch shapes and gathers at ~1/6 of streaming bandwidth).
 
 
+@kernel_contract(
+    "downsample_regular", kind="jit",
+    example=lambda: (
+        (_sds((8, 64), jnp.int64), _sds((8, 64), jnp.float64),
+         _sds((), jnp.int64), _sds((), jnp.int64)),
+        {"R": 4, "nperiods": 8, "c0": 2, "down": False}),
+    expect=_six_expect(8, 8),
+    notes="regular-cadence reshape fast path; dispatch gated by "
+          "regular_cadence (jitter strictly under dt/2, res % dt == 0)")
 @functools.partial(jax.jit,
                    static_argnames=("R", "nperiods", "c0", "down"))
 def _ds_regular(ts, vals, base, res, R: int, nperiods: int, c0: int,
@@ -333,6 +381,15 @@ def downsample_gauge_fast(ts_pad, vals_pad, lens, base, res,
                        c0, down)
 
 
+@kernel_contract(
+    "cascade_aligned", kind="jit",
+    example=lambda: (
+        (tuple(_sds((8, 16), jnp.float64) for _ in range(5))
+         + (_sds((8, 16), jnp.int64),), 4, 1),
+        {}),
+    expect=_six_expect(8, 5),       # Q = ceil((16 + 1) / 4)
+    notes="nested-resolution cascade: reshape + NaN-aware reduce over "
+          "ratio consecutive fine periods")
 @functools.partial(jax.jit, static_argnames=("ratio", "lead"))
 def cascade_gauge_aligned(prev, ratio: int, lead: int):
     """Coarse level from a fine level when the resolutions nest

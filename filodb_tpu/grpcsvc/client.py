@@ -32,6 +32,9 @@ from filodb_tpu.testing import chaos
 _SERVICE = "filodb.QueryService"
 _channels: Dict[str, object] = {}
 _channels_lock = threading.Lock()
+# graftlint lock-discipline declaration for module-global state: the
+# channel cache is shared by every query thread dialing peers
+__guarded_by__ = {"_channels": "_channels_lock"}
 
 
 def _channel(addr: str):
@@ -215,16 +218,24 @@ class GrpcRemoteExec:
             wire.decode_exec_response(buf)
         if error:
             raise QueryError(f"remote node {self.node_id}: {error}")
+        partial = bool(stats.get("partial"))
+        warnings = list(stats.get("warnings") or ())
         if self.stats is not None:
             self.stats.series_scanned += stats.get("seriesScanned", 0)
             self.stats.samples_scanned += stats.get("samplesScanned", 0)
+            # degraded peer: keep the markers flowing exactly like the
+            # HTTP plane (prom_json.attach_degraded reads these)
+            self.stats.partial = self.stats.partial or partial
+            self.stats.warnings.extend(
+                w for w in warnings if w not in self.stats.warnings)
         # align the peer's grid onto the local step grid (identical for
         # range queries; instant queries return a single step)
         params = RangeParams(self.start_ms, self.step_ms, self.end_ms)
         want = params.steps
         if steps.size == want.size and np.array_equal(steps, want):
             return GridResult(want, keys, values, hist_values=hv,
-                              bucket_les=les)
+                              bucket_les=les, partial=partial,
+                              warnings=warnings)
         out = np.full((len(keys), want.size), np.nan)
         idx = np.searchsorted(want, steps)
         ok = (idx < want.size) & (want[np.clip(idx, 0, want.size - 1)]
@@ -239,7 +250,8 @@ class GrpcRemoteExec:
                              np.nan)
             hv_out[:, idx[ok], :] = hv[:, ok, :]
         return GridResult(want, keys, out, hist_values=hv_out,
-                          bucket_les=les if hv_out is not None else None)
+                          bucket_les=les if hv_out is not None else None,
+                          partial=partial, warnings=warnings)
 
     def plan_tree(self, indent: int = 0) -> str:
         return (" " * indent + f"GrpcRemoteExec(node={self.node_id}, "

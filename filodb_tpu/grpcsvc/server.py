@@ -19,10 +19,12 @@ from concurrent import futures
 from typing import Optional
 
 from filodb_tpu.grpcsvc import wire
+from filodb_tpu.lint.locks import guarded_by
 
 _SERVICE = "filodb.QueryService"
 
 
+@guarded_by("_rpc_lock", "rpcs_served")
 class GrpcQueryServer:
     """Binds the service to a FiloHttpServer's query surface (the HTTP
     server owns planners, shard maps, and guardrails; this is a second

@@ -27,7 +27,8 @@ Messages (field numbers):
                  NaN where absent), 3: hist nibble flat, 4: nb}
   ExecResponse  {1: ExecSeries*, 2: error, 3: steps nibble,
                  4: series_scanned, 5: samples_scanned,
-                 6: les f64le, 7: scalar flag}
+                 6: les f64le, 7: scalar flag, 8: partial flag,
+                 9: warning string*}
 """
 
 from __future__ import annotations
@@ -309,6 +310,19 @@ def encode_exec_response(grid, stats=None, error: str = "") -> bytes:
     if stats is not None:
         out += _vi(4, int(getattr(stats, "series_scanned", 0)))
         out += _vi(5, int(getattr(stats, "samples_scanned", 0)))
+    # degraded-mode provenance (the HTTP plane's "partial"/"warnings"
+    # envelope): union of grid- and stats-level markers so a pushdown
+    # peer's degradation survives the binary hop
+    partial = bool(getattr(grid, "partial", False)) \
+        or bool(getattr(stats, "partial", False))
+    warnings = list(getattr(grid, "warnings", ()) or ())
+    for w in getattr(stats, "warnings", ()) or ():
+        if w not in warnings:
+            warnings.append(w)
+    if partial:
+        out += _vi(8, 1)
+    for w in warnings:
+        out += _ld(9, str(w).encode())
     return bytes(out)
 
 
@@ -318,7 +332,8 @@ def decode_exec_response(buf: bytes):
     steps = np.zeros(0, np.int64)
     rows = []
     les = None
-    stats = {"seriesScanned": 0, "samplesScanned": 0}
+    stats = {"seriesScanned": 0, "samplesScanned": 0,
+             "partial": False, "warnings": []}
     error = ""
     for f, _, v in _fields(buf):
         if f == 3:
@@ -333,6 +348,10 @@ def decode_exec_response(buf: bytes):
             stats["samplesScanned"] = v
         elif f == 6:
             les = np.frombuffer(v, "<f8")
+        elif f == 8:
+            stats["partial"] = bool(v)
+        elif f == 9:
+            stats["warnings"].append(v.decode())
     if error:
         return None, [], None, None, None, stats, error
     # nibble streams decode in 8-word groups, so counts ride explicitly

@@ -484,6 +484,21 @@ class FiloHttpServer:
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
+        breakers = getattr(self.resilience, "breakers", None)
+        if breakers is not None:
+            # degraded-mode counters (PR 1 follow-up): per-peer breaker
+            # state + retry-policy attempts/retries/exhaustions/
+            # rejections from the server-lifetime BreakerRegistry
+            for peer, entry in sorted(breakers.metrics_snapshot().items()):
+                state = entry.get("state")
+                if state is not None:
+                    emit("breaker_state",
+                         {"peer": peer, "state": state}, 1)
+                for k in ("attempts", "retries", "exhaustions",
+                          "rejections"):
+                    if k in entry:
+                        emit(f"peer_call_{k}_total", {"peer": peer},
+                             entry[k])
         meter = getattr(self, "tenant_metering", None)
         if meter is not None:
             # periodic per-tenant cardinality gauges

@@ -35,6 +35,7 @@ import numpy as np
 
 from filodb_tpu.core.record import PartKey, RecordContainer
 from filodb_tpu.core.schemas import ColumnType, Schemas
+from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.memory.histogram import _decode_scheme, _encode_scheme
 
 _REC_MAGIC = 0xF10D
@@ -67,6 +68,7 @@ class IngestionStream:
         pass
 
 
+@guarded_by("_lock", "_records")
 class MemoryIngestionStream(IngestionStream):
     """In-process stream for tests and embedded producers."""
 
@@ -174,6 +176,10 @@ def decode_container(buf: bytes, off: int, schemas: Schemas
     return cont, end
 
 
+# producer and consumer sides may be different THREADS in one process
+# (embedded gateway + ingest driver): the writer handle, the record
+# position index, and the valid-prefix watermark all ride one lock
+@guarded_by("_lock", "_write_f", "_positions", "_valid_end")
 class LogIngestionStream(IngestionStream):
     """Durable file-backed stream: one append-only framed log per shard —
     the Kafka-partition analogue (1 shard <-> 1 log, KafkaIngestionStream).

@@ -1,0 +1,306 @@
+"""graftlint: static analysis for the invariants this repo's hot path
+lives by.
+
+The Pallas/JAX hot loop is hand-budgeted — VMEM footprints, (8, 128)
+trailing-dim tiling, the int31 relative-timestamp span guard, exact
+f64->3xf32 splits — and the threaded layers (memstore, ingest streams,
+gRPC service, resilience) grow locks organically. Those invariants
+historically lived in docstrings and in the builder's head; graftlint
+makes them *checked*, on every PR, on CPU-only CI, before anything
+touches a TPU.
+
+Three rule families (see the rule modules for the catalog):
+
+  * ``rules_kernel`` — kernel contracts: every ``pallas_call`` site
+    carries a :func:`filodb_tpu.lint.contracts.kernel_contract`
+    declaration (block shapes, dtypes, scratch, budget); the checker
+    recomputes the VMEM footprint, verifies trailing-dim tiling,
+    grid/index-map bounds, the int31 span guard, and abstract-evals the
+    wrapper via ``jax.eval_shape`` — no TPU needed.
+  * ``rules_trace`` — trace safety: AST pass over functions reachable
+    under ``jax.jit`` / ``shard_map`` / ``pallas_call`` flagging Python
+    side effects, tracer leaks, captured-container mutation, and 64-bit
+    dtypes inside Pallas kernel bodies.
+  * ``rules_lock`` — lock discipline:
+    :func:`filodb_tpu.lint.locks.guarded_by` annotations on shared
+    fields, checked for access outside a ``with <lock>:`` scope and for
+    blocking calls made while a lock is held.
+
+Mechanics:
+
+  * run it: ``python -m filodb_tpu.lint`` (add ``--json`` for
+    machine-readable findings); tier-1 runs it via
+    ``tests/test_lint_clean.py``.
+  * suppress one finding: ``# graftlint: disable=<rule> (reason)`` on
+    the offending line or the line above it. A reason string is
+    required — bare disables are themselves a finding.
+  * grandfather findings: ``filodb_tpu/lint/baseline.json`` holds keys
+    of known findings; the run fails only on NEW findings. The shipped
+    baseline is empty — keep it that way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([\w\-,]+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str               # repo-relative, forward slashes
+    line: int
+    message: str
+    severity: str = ERROR
+    context: str = ""       # enclosing qualname (stable across line drift)
+
+    def key(self) -> str:
+        """Stable identity for baseline matching: deliberately excludes
+        the line number so unrelated edits don't churn the baseline."""
+        return f"{self.path}::{self.rule}::{self.context or self.message}"
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.message}")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One registered rule: AST rules get a per-module hook, runtime
+    rules (kernel contracts) run once over the imported registry."""
+    id: str
+    family: str             # kernel | trace | lock | meta
+    severity: str
+    doc: str
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(id: str, family: str, doc: str,
+                  severity: str = ERROR) -> Rule:
+    rule = Rule(id=id, family=family, severity=severity, doc=doc)
+    _RULES[id] = rule
+    return rule
+
+
+def rules() -> Dict[str, Rule]:
+    """The rule catalog (id -> Rule), importing all rule modules."""
+    _load_rule_modules()
+    return dict(_RULES)
+
+
+register_rule(
+    "pragma-no-reason", "meta",
+    "a `# graftlint: disable=` pragma must carry a (reason) string")
+register_rule(
+    "pragma-unknown-rule", "meta",
+    "a pragma disables a rule id that does not exist")
+
+
+@dataclass
+class ModuleSource:
+    """Parsed view of one file handed to AST rules."""
+    path: str               # absolute
+    relpath: str            # repo/package-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    # line -> (set of disabled rule ids, reason or None)
+    pragmas: Dict[int, Tuple[frozenset, Optional[str]]]
+
+
+def _parse_pragmas(lines: Sequence[str]
+                   ) -> Dict[int, Tuple[frozenset, Optional[str]]]:
+    out: Dict[int, Tuple[frozenset, Optional[str]]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if m:
+            ids = frozenset(x.strip() for x in m.group(1).split(",")
+                            if x.strip())
+            out[i] = (ids, m.group(2))
+    return out
+
+
+def load_module(path: str, root: Optional[str] = None
+                ) -> Optional[ModuleSource]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError):
+        return None
+    rel = os.path.relpath(path, root) if root else path
+    rel = rel.replace(os.sep, "/")
+    lines = source.splitlines()
+    return ModuleSource(path=path, relpath=rel, source=source, tree=tree,
+                        lines=lines, pragmas=_parse_pragmas(lines))
+
+
+def _suppressed(mod: ModuleSource, f: Finding) -> bool:
+    """A finding is suppressed by a pragma on its line or the line
+    directly above it naming its rule (or `all`)."""
+    for ln in (f.line, f.line - 1):
+        entry = mod.pragmas.get(ln)
+        if entry and (f.rule in entry[0] or "all" in entry[0]):
+            return True
+    return False
+
+
+def _pragma_findings(mod: ModuleSource) -> List[Finding]:
+    out = []
+    known = set(_RULES)
+    for ln, (ids, reason) in mod.pragmas.items():
+        if not reason or not reason.strip():
+            out.append(Finding(
+                rule="pragma-no-reason", path=mod.relpath, line=ln,
+                message="disable pragma without a (reason) string",
+                context=f"pragma:{','.join(sorted(ids))}"))
+        for rid in ids:
+            if rid != "all" and rid not in known:
+                out.append(Finding(
+                    rule="pragma-unknown-rule", path=mod.relpath, line=ln,
+                    message=f"pragma disables unknown rule {rid!r}",
+                    context=f"pragma:{rid}"))
+    return out
+
+
+# -- baseline ---------------------------------------------------------------
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> frozenset:
+    path = path or baseline_path()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return frozenset()
+    return frozenset(data.get("findings", []))
+
+
+# -- runner -----------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)   # new (fail)
+    baselined: List[Finding] = field(default_factory=list)  # grandfathered
+    suppressed: int = 0
+    files: int = 0
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def to_json(self) -> Dict:
+        return {"files": self.files,
+                "findings": [f.to_json() for f in self.findings],
+                "baselined": [f.to_json() for f in self.baselined],
+                "suppressed": self.suppressed,
+                "exit_code": 1 if self.errors else 0}
+
+
+def package_root() -> str:
+    """Directory containing the ``filodb_tpu`` package (the repo root
+    when run from a checkout)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(os.path.abspath(p))
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.abspath(
+                            os.path.join(dirpath, fn)))
+    return out
+
+
+_rule_modules_loaded = False
+
+
+def _load_rule_modules() -> None:
+    global _rule_modules_loaded
+    if _rule_modules_loaded:
+        return
+    _rule_modules_loaded = True
+    from filodb_tpu.lint import rules_kernel, rules_lock, rules_trace  # noqa: F401
+
+
+def run_lint(paths: Optional[Sequence[str]] = None, *,
+             baseline: Optional[frozenset] = None,
+             check_contracts: bool = True) -> LintResult:
+    """Lint ``paths`` (default: the ``filodb_tpu`` package).
+
+    AST rules run per file; when ``check_contracts`` is set, files that
+    belong to an importable package are imported and every registered
+    :class:`~filodb_tpu.lint.contracts.KernelContract` they declare is
+    verified (VMEM budget, tiling, grid bounds, span guard,
+    ``jax.eval_shape``)."""
+    _load_rule_modules()
+    from filodb_tpu.lint import rules_kernel, rules_lock, rules_trace
+    root = package_root()
+    if paths is None:
+        paths = [os.path.join(root, "filodb_tpu")]
+    if baseline is None:
+        baseline = load_baseline()
+    files = iter_py_files(paths)
+    result = LintResult(files=len(files))
+    mods: List[ModuleSource] = []
+    for path in files:
+        mod = load_module(path, root=root)
+        if mod is None:
+            continue
+        mods.append(mod)
+    # two passes: lock declarations are collected package-wide first so
+    # cross-class (foreign-object) guarded accesses resolve
+    lock_decls = rules_lock.collect_declarations(mods)
+    raw: List[Tuple[ModuleSource, Finding]] = []
+    for mod in mods:
+        for f in _pragma_findings(mod):
+            raw.append((mod, f))
+        for f in rules_kernel.check_module(mod):
+            raw.append((mod, f))
+        for f in rules_trace.check_module(mod):
+            raw.append((mod, f))
+        for f in rules_lock.check_module(mod, lock_decls):
+            raw.append((mod, f))
+    if check_contracts:
+        bymod = {m.relpath: m for m in mods}
+        for relpath, f in rules_kernel.check_contracts(mods, root):
+            mod = bymod.get(relpath)
+            raw.append((mod, f) if mod is not None else (None, f))
+    for mod, f in raw:
+        if mod is not None and _suppressed(mod, f):
+            result.suppressed += 1
+        elif f.key() in baseline:
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

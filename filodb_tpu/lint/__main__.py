@@ -1,0 +1,60 @@
+"""``python -m filodb_tpu.lint`` — run graftlint.
+
+Exit codes: 0 = clean (no new error-severity findings), 1 = findings,
+2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from filodb_tpu.lint import load_baseline, rules, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m filodb_tpu.lint",
+        description="graftlint: kernel-contract, trace-safety, and "
+                    "lock-discipline static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "filodb_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the shipped "
+                         "filodb_tpu/lint/baseline.json)")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip runtime kernel-contract verification "
+                         "(AST rules only)")
+    ap.add_argument("--rules", action="store_true", dest="list_rules",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(rules().items()):
+            print(f"{rid:26s} [{rule.family}/{rule.severity}] {rule.doc}")
+        return 0
+
+    result = run_lint(args.paths or None,
+                      baseline=load_baseline(args.baseline),
+                      check_contracts=not args.no_contracts)
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        for f in result.baselined:
+            print(f"{f.render()}  (baselined)")
+        status = "clean" if not result.errors else \
+            f"{len(result.errors)} error(s)"
+        print(f"graftlint: {result.files} file(s), {status}, "
+              f"{len(result.baselined)} baselined, "
+              f"{result.suppressed} suppressed", file=sys.stderr)
+    return 1 if result.errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Kernel-contract rules.
+
+AST side: every ``pallas_call`` site must sit inside a function (or a
+lexically enclosing function) decorated with
+:func:`filodb_tpu.lint.contracts.kernel_contract`.
+
+Runtime side (still CPU-only — nothing executes on device): every
+registered contract is re-verified from its declaration:
+
+  * ``kernel-contract-missing`` — a ``pallas_call`` with no enclosing
+    contract declaration.
+  * ``kernel-vmem-budget`` — the declared worst-case blocks + scratch +
+    outputs don't fit the declared VMEM budget (or a Pallas contract
+    declares no budget at all, or budgets past physical VMEM).
+  * ``kernel-tile-alignment`` — a VMEM block's trailing dims don't tile
+    to (sublane, 128) for its dtype, or an 8-byte dtype is placed in
+    VMEM (Mosaic legalizes neither f64 nor i64 vectors).
+  * ``kernel-grid-bounds`` — a declared index_map sends some grid point
+    out of its array's bounds.
+  * ``kernel-span-guard`` — a contract declares int31 relative
+    timestamps but names no resolvable dispatcher predicate proving the
+    span fits.
+  * ``kernel-abstract-eval`` — ``jax.eval_shape`` of the entry point
+    over the contract's example inputs fails or disagrees with the
+    declared outputs.
+  * ``kernel-module-import`` — a kernel module failed to import, so its
+    contracts could not be checked.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+from typing import Iterable, List, Optional, Tuple
+
+from filodb_tpu.lint import Finding, ModuleSource, register_rule
+from filodb_tpu.lint.contracts import (SUBLANE_BY_ITEMSIZE, VMEM_BYTES,
+                                       KernelContract, contracts_for_module)
+
+register_rule("kernel-contract-missing", "kernel",
+              "pallas_call site without an enclosing @kernel_contract "
+              "declaration")
+register_rule("kernel-vmem-budget", "kernel",
+              "declared blocks+scratch exceed the kernel's VMEM budget")
+register_rule("kernel-tile-alignment", "kernel",
+              "VMEM block trailing dims must tile to (sublane, 128)")
+register_rule("kernel-grid-bounds", "kernel",
+              "grid/index-map sends a block out of its array's bounds")
+register_rule("kernel-span-guard", "kernel",
+              "int31 relative-timestamp kernel without a resolvable "
+              "dispatcher span guard")
+register_rule("kernel-abstract-eval", "kernel",
+              "jax.eval_shape of the kernel entry point fails or "
+              "disagrees with the declared outputs")
+register_rule("kernel-module-import", "kernel",
+              "kernel module failed to import; contracts unchecked")
+
+_GRID_POINT_CAP = 1 << 16
+
+
+def _is_kernel_contract_deco(d: ast.expr) -> bool:
+    target = d.func if isinstance(d, ast.Call) else d
+    if isinstance(target, ast.Attribute):
+        return target.attr == "kernel_contract"
+    return isinstance(target, ast.Name) and target.id == "kernel_contract"
+
+
+def _has_contract(stack: List[ast.AST]) -> bool:
+    for node in stack:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_kernel_contract_deco(d) for d in node.decorator_list):
+                return True
+    return False
+
+
+def check_module(mod: ModuleSource) -> Iterable[Finding]:
+    """AST pass: pallas_call sites must carry a contract."""
+    findings: List[Finding] = []
+
+    def walk(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if name == "pallas_call" and not _has_contract(stack):
+                qual = ".".join(
+                    n.name for n in stack
+                    if isinstance(n, (ast.FunctionDef, ast.ClassDef)))
+                findings.append(Finding(
+                    rule="kernel-contract-missing", path=mod.relpath,
+                    line=node.lineno,
+                    message="pallas_call site has no enclosing "
+                            "@kernel_contract declaration",
+                    context=qual or "<module>"))
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child, stack)
+        stack.pop()
+
+    walk(mod.tree, [])
+    return findings
+
+
+# -- runtime contract verification ------------------------------------------
+
+def _contract_line(c: KernelContract) -> int:
+    try:
+        return inspect.getsourcelines(inspect.unwrap(c.fn))[1]
+    except (OSError, TypeError):
+        return 1
+
+
+def _finding(c: KernelContract, relpath: str, rule: str, check: str,
+             message: str) -> Finding:
+    return Finding(rule=rule, path=relpath, line=_contract_line(c),
+                   message=f"contract {c.name!r}: {message}",
+                   context=f"contract:{c.name}:{check}")
+
+
+def check_contract(c: KernelContract, relpath: str = "") -> List[Finding]:
+    """Verify one contract declaration. Pure CPU: block arithmetic plus
+    ``jax.eval_shape`` — the kernel is never executed."""
+    out: List[Finding] = []
+    relpath = relpath or (c.module.replace(".", "/") + ".py")
+
+    # VMEM budget
+    if c.kind == "pallas" and c.vmem_budget is None:
+        out.append(_finding(c, relpath, "kernel-vmem-budget", "declared",
+                            "pallas kernel declares no VMEM budget"))
+    if c.vmem_budget is not None:
+        if c.vmem_budget > VMEM_BYTES:
+            out.append(_finding(
+                c, relpath, "kernel-vmem-budget", "physical",
+                f"budget {c.vmem_budget} exceeds physical VMEM "
+                f"{VMEM_BYTES}"))
+        fp = c.vmem_footprint()
+        if fp > c.vmem_budget:
+            out.append(_finding(
+                c, relpath, "kernel-vmem-budget", "footprint",
+                f"worst-case VMEM footprint {fp} bytes exceeds the "
+                f"declared budget {c.vmem_budget}"))
+
+    # tiling (pallas only: XLA kernels have no Mosaic tiling constraint)
+    if c.kind == "pallas":
+        for b in c.all_vmem_blocks():
+            if b.itemsize() > 4:
+                out.append(_finding(
+                    c, relpath, "kernel-tile-alignment",
+                    f"dtype:{b.name}",
+                    f"block {b.name!r} places 8-byte dtype {b.dtype} "
+                    f"in VMEM (Mosaic has no f64/i64 vectors)"))
+                continue
+            if not b.tiled or len(b.shape) < 2:
+                continue
+            sub = SUBLANE_BY_ITEMSIZE.get(b.itemsize(), 8)
+            if b.shape[-1] % 128 or b.shape[-2] % sub:
+                out.append(_finding(
+                    c, relpath, "kernel-tile-alignment", f"tile:{b.name}",
+                    f"block {b.name!r} shape {b.shape} trailing dims "
+                    f"must be multiples of ({sub}, 128) for {b.dtype}"))
+
+    # grid/index-map bounds
+    if c.grid:
+        npoints = 1
+        for g in c.grid:
+            npoints *= max(int(g), 1)
+        points: List[Tuple[int, ...]] = []
+        if npoints <= _GRID_POINT_CAP:
+            idx = [0] * len(c.grid)
+            for _ in range(npoints):
+                points.append(tuple(idx))
+                for d in range(len(c.grid) - 1, -1, -1):
+                    idx[d] += 1
+                    if idx[d] < c.grid[d]:
+                        break
+                    idx[d] = 0
+        else:   # corners only for very large grids
+            points = [tuple(0 for _ in c.grid),
+                      tuple(g - 1 for g in c.grid)]
+        for b in (*c.blocks, *c.outputs):
+            if b.index_map is None or b.array_shape is None:
+                continue
+            for pt in points:
+                bi = b.index_map(*pt)
+                if not isinstance(bi, tuple):
+                    bi = (bi,)
+                if len(bi) != len(b.shape) or len(bi) != len(b.array_shape):
+                    out.append(_finding(
+                        c, relpath, "kernel-grid-bounds", f"rank:{b.name}",
+                        f"block {b.name!r} index_map rank {len(bi)} != "
+                        f"block rank {len(b.shape)}"))
+                    break
+                bad = any(
+                    i < 0 or i * bd >= ad
+                    for i, bd, ad in zip(bi, b.shape, b.array_shape))
+                if bad:
+                    out.append(_finding(
+                        c, relpath, "kernel-grid-bounds",
+                        f"bounds:{b.name}",
+                        f"block {b.name!r} index_map{pt} -> {bi} starts "
+                        f"outside array {b.array_shape}"))
+                    break
+
+    # int31 span guard: `name` resolves in the contract's module,
+    # `pkg.mod:name` in the named module (guards usually live in the
+    # dispatcher, not next to the kernel)
+    if c.rel_time_bits is not None:
+        ok = False
+        if c.span_guard:
+            modname, _, attr = c.span_guard.rpartition(":")
+            modname = modname or c.module
+            try:
+                target = importlib.import_module(modname)
+                for part in attr.split("."):
+                    target = getattr(target, part)
+                ok = callable(target)
+            except (ImportError, AttributeError):
+                ok = False
+        if not ok:
+            out.append(_finding(
+                c, relpath, "kernel-span-guard", "guard",
+                f"declares int{c.rel_time_bits} relative timestamps but "
+                f"span guard {c.span_guard!r} does not resolve to a "
+                f"callable in {c.module}"))
+
+    # abstract evaluation (jax.eval_shape — traces, never runs)
+    if c.check is not None:
+        try:
+            err = c.check()
+        except Exception as e:      # noqa: BLE001 — report, don't crash
+            err = f"{type(e).__name__}: {e}"
+        if err:
+            out.append(_finding(c, relpath, "kernel-abstract-eval",
+                                "check", str(err)))
+    elif c.example is not None:
+        try:
+            import jax
+            args, kwargs = c.example()
+            # only ShapeDtypeStructs (or containers of them) become
+            # abstract arrays; everything else (mode flags, static
+            # shapes, window params) binds concretely, the way the
+            # dispatcher passes them
+            def _is_abstract(a):
+                if isinstance(a, jax.ShapeDtypeStruct):
+                    return True
+                if isinstance(a, (tuple, list, dict)):
+                    return any(isinstance(x, jax.ShapeDtypeStruct)
+                               for x in jax.tree_util.tree_leaves(a))
+                return False
+
+            abstract = [a for a in args if _is_abstract(a)]
+
+            def _bound(*arrs, _args=tuple(args), _kw=kwargs):
+                it = iter(arrs)
+                full = [next(it) if _is_abstract(a) else a
+                        for a in _args]
+                return c.fn(*full, **_kw)
+
+            res = jax.eval_shape(_bound, *abstract)
+            err = c.expect(res) if c.expect is not None else None
+        except Exception as e:      # noqa: BLE001 — report, don't crash
+            err = f"{type(e).__name__}: {e}"
+        if err:
+            out.append(_finding(c, relpath, "kernel-abstract-eval",
+                                "eval_shape", str(err)))
+    return out
+
+
+def _module_name(relpath: str) -> Optional[str]:
+    if not relpath.endswith(".py"):
+        return None
+    parts = relpath[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or parts[0] != "filodb_tpu":
+        return None
+    return ".".join(parts)
+
+
+def check_contracts(mods, root: str
+                    ) -> Iterable[Tuple[str, Finding]]:
+    """Import every linted package module and verify the contracts it
+    registered."""
+    out: List[Tuple[str, Finding]] = []
+    for mod in mods:
+        name = _module_name(mod.relpath)
+        if name is None:
+            continue
+        # cheap AST gate: only import modules that mention the decorator
+        # or pallas_call (importing the whole package pulls optional deps)
+        if "kernel_contract" not in mod.source \
+                and "pallas_call" not in mod.source:
+            continue
+        try:
+            modobj = importlib.import_module(name)
+        except Exception as e:      # noqa: BLE001 — surface, don't crash
+            out.append((mod.relpath, Finding(
+                rule="kernel-module-import", path=mod.relpath, line=1,
+                message=f"import failed, contracts unchecked: "
+                        f"{type(e).__name__}: {e}",
+                context=f"import:{name}")))
+            continue
+        for c in contracts_for_module(name):
+            for f in check_contract(c, mod.relpath):
+                out.append((mod.relpath, f))
+    return out

@@ -1,0 +1,443 @@
+"""Trace-safety rules.
+
+A module-local reachability analysis finds every function that can run
+under a JAX trace — ``jax.jit`` / ``shard_map`` decorated or wrapped
+functions, functions handed to ``jit(...)`` / ``shard_map(...)`` /
+``pallas_call(...)`` (directly or through ``functools.partial``),
+Pallas kernel bodies (any function with a ``*_ref`` parameter — the
+Ref-passing convention all kernels here follow), and everything those
+functions mention or lexically contain.
+
+Inside traced functions the rules flag:
+
+  * ``trace-side-effect`` — Python work that silently burns into the
+    trace as a constant or runs once per (re)trace instead of per call:
+    ``time.time()``-family reads, ``print``, stdlib/numpy ``random``,
+    ``open``/``input``/``os.urandom``.
+  * ``trace-tracer-leak`` — host escapes that crash or silently
+    constant-fold under trace: ``.item()``, ``bool()/int()/float()`` on
+    a non-static parameter (static ``static_argnames`` / partial-bound
+    parameters are exempt), a bare tracer parameter interpolated into
+    an f-string.
+  * ``trace-mutate-capture`` — mutating a captured Python container
+    (append/update/subscript-assign/``global``) on a name that is not
+    local to the function or any lexically enclosing function: the
+    mutation escapes the trace and happens once, at trace time, not per
+    call. Closure-local accumulation (DMA lists, Ref stores captured
+    from the enclosing kernel) is the normal Pallas/JAX idiom and is
+    allowed.
+  * ``trace-f64-constant`` — 64-bit dtypes (``float64``/``int64``)
+    mentioned inside a Pallas kernel body; Mosaic cannot legalize
+    64-bit vectors, which is why the wrappers trace under
+    ``_enable_x64(False)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from filodb_tpu.lint import Finding, ModuleSource, register_rule
+
+register_rule("trace-side-effect", "trace",
+              "Python side effect inside a jit/shard_map/pallas-traced "
+              "function")
+register_rule("trace-tracer-leak", "trace",
+              "tracer escapes to host: .item(), bool()/int()/float() "
+              "coercion, or tracer in f-string")
+register_rule("trace-mutate-capture", "trace",
+              "mutation of a captured Python container inside a traced "
+              "function")
+register_rule("trace-f64-constant", "trace",
+              "64-bit dtype inside a Pallas kernel body (Mosaic cannot "
+              "legalize f64/i64 vectors)")
+
+_TIME_FNS = {"time", "monotonic", "perf_counter", "sleep", "process_time",
+             "time_ns", "monotonic_ns", "perf_counter_ns", "clock"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "setdefault", "add", "discard", "sort",
+             "reverse", "write"}
+_JIT_MARKERS = ("jit", "shard_map", "pmap")
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+@dataclass(eq=False)            # identity hash: nodes index sets/dicts
+class FnInfo:
+    node: ast.AST                     # FunctionDef | AsyncFunctionDef
+    qualname: str
+    params: List[str]
+    static_params: Set[str] = field(default_factory=set)
+    traced: bool = False
+    pallas_body: bool = False
+    parent: Optional["FnInfo"] = None
+    locals_cache: Optional[Set[str]] = None
+
+
+class _Index(ast.NodeVisitor):
+    """Collect imports, function defs (with lexical parents), and
+    trace roots."""
+
+    def __init__(self) -> None:
+        self.fns: List[FnInfo] = []
+        self.by_node: Dict[ast.AST, FnInfo] = {}
+        self.by_name: Dict[str, List[FnInfo]] = {}
+        self.time_aliases: Set[str] = set()
+        self.random_aliases: Set[str] = set()
+        self.numpy_aliases: Set[str] = set()
+        self.os_aliases: Set[str] = set()
+        # local name -> (module, original) for from-imports
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.module_aliases: Set[str] = set()
+        self._stack: List[FnInfo] = []
+
+    # imports ---------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            root = a.name.split(".")[0]
+            self.module_aliases.add(local)
+            if root == "time":
+                self.time_aliases.add(local)
+            elif root == "random":
+                self.random_aliases.add(local)
+            elif root == "os":
+                self.os_aliases.add(local)
+            elif root == "numpy" or a.name in ("jax.numpy",):
+                self.numpy_aliases.add(local)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for a in node.names:
+            local = a.asname or a.name
+            self.from_imports[local] = (mod, a.name)
+            if mod in ("jax", "jax.experimental") \
+                    and a.name in ("numpy",):
+                self.numpy_aliases.add(local)
+            if mod.split(".")[0] in ("jax", "numpy", "functools", "os",
+                                     "time", "random", "typing"):
+                self.module_aliases.add(local)
+        self.generic_visit(node)
+
+    # functions -------------------------------------------------------
+    def _params_of(self, node) -> List[str]:
+        a = node.args
+        names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def _static_from_deco(self, deco: ast.expr) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(deco, ast.Call):
+            for kw in deco.keywords:
+                if kw.arg in ("static_argnames", "static_argnums") \
+                        and isinstance(kw.value, (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) \
+                                and isinstance(el.value, str):
+                            out.add(el.value)
+                elif kw.arg == "static_argnames" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    out.add(kw.value.value)
+        return out
+
+    def _visit_fn(self, node) -> None:
+        qual = ".".join([f.node.name for f in self._stack] + [node.name])
+        info = FnInfo(node=node, qualname=qual,
+                      params=self._params_of(node),
+                      parent=self._stack[-1] if self._stack else None)
+        for d in node.decorator_list:
+            try:
+                text = ast.unparse(d)
+            except Exception:       # noqa: BLE001
+                text = ""
+            if any(m in text for m in _JIT_MARKERS):
+                info.traced = True
+                info.static_params |= self._static_from_deco(d)
+        if any(p.endswith("_ref") for p in info.params):
+            info.traced = True
+            info.pallas_body = True
+        self.fns.append(info)
+        self.by_node[node] = info
+        self.by_name.setdefault(node.name, []).append(info)
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # call-site roots: jit(f) / shard_map(f) / pallas_call(f) ----------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("jit", "pallas_call") or "shard_map" in leaf:
+            static = self._static_from_deco(node)
+            if node.args:
+                self._mark_root(node.args[0], static,
+                                pallas=(leaf == "pallas_call"))
+        self.generic_visit(node)
+
+    def _mark_root(self, arg: ast.expr, static: Set[str],
+                   pallas: bool) -> None:
+        target: Optional[str] = None
+        bound = 0
+        if isinstance(arg, ast.Name):
+            target = arg.id
+        elif isinstance(arg, ast.Call):
+            fname = _dotted(arg.func) or ""
+            if fname.rsplit(".", 1)[-1] == "partial" and arg.args:
+                inner = arg.args[0]
+                if isinstance(inner, ast.Name):
+                    target = inner.id
+                    bound = len(arg.args) - 1
+        if target is None:
+            return
+        for info in self.by_name.get(target, ()):  # module-wide by name
+            info.traced = True
+            if pallas:
+                info.pallas_body = True
+            info.static_params |= set(info.params[:bound]) | static
+
+
+def _reachable(index: _Index) -> Set[FnInfo]:
+    """Fixpoint: roots + lexical children + name mentions."""
+    reach: Set[FnInfo] = {f for f in index.fns if f.traced}
+    changed = True
+    while changed:
+        changed = False
+        for f in index.fns:
+            if f in reach:
+                continue
+            # lexical containment: a def inside a traced function runs
+            # under that trace (fori_loop bodies, pl.when branches)
+            if f.parent is not None and f.parent in reach:
+                # propagate pallas-body-ness to nested helpers
+                f.pallas_body = f.pallas_body or f.parent.pallas_body
+                reach.add(f)
+                changed = True
+        # mentions: a reachable function naming another function pulls
+        # it in (helpers called, callbacks passed)
+        for f in list(reach):
+            for node in ast.walk(f.node):
+                if isinstance(node, ast.Name) \
+                        and node.id in index.by_name:
+                    for g in index.by_name[node.id]:
+                        if g is not f and g not in reach:
+                            g.pallas_body = g.pallas_body or f.pallas_body
+                            reach.add(g)
+                            changed = True
+    return reach
+
+
+def _locals_with_ancestors(info: FnInfo) -> Set[str]:
+    """Locals of the function plus every lexical ancestor — the set of
+    names whose mutation stays inside the trace closure."""
+    out: Set[str] = set()
+    cur: Optional[FnInfo] = info
+    while cur is not None:
+        out |= _locals_of(cur)
+        cur = cur.parent
+    return out
+
+
+def _locals_of(info: FnInfo) -> Set[str]:
+    if info.locals_cache is not None:
+        return info.locals_cache
+    out: Set[str] = set(info.params)
+
+    def add_target(t: ast.expr) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                add_target(el)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+        elif isinstance(node, ast.For):
+            add_target(node.target)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            add_target(node.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            add_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            add_target(node.target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                out.add(a.asname or a.name.split(".")[0])
+    info.locals_cache = out
+    return out
+
+
+def _own_nodes(info: FnInfo, index: _Index) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested defs (they
+    are checked as their own functions)."""
+    stack = list(ast.iter_child_nodes(info.node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if node in index.by_node:
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_module(mod: ModuleSource) -> Iterable[Finding]:
+    index = _Index()
+    index.visit(mod.tree)
+    reach = _reachable(index)
+    findings: List[Finding] = []
+
+    def emit(rule: str, node: ast.AST, info: FnInfo, msg: str) -> None:
+        findings.append(Finding(
+            rule=rule, path=mod.relpath,
+            line=getattr(node, "lineno", 1), message=msg,
+            context=f"{info.qualname}:{msg}"))
+
+    for info in sorted(reach, key=lambda f: f.node.lineno):
+        local = _locals_with_ancestors(info)
+        tracers = set(info.params) - info.static_params
+        # f-strings inside `raise` build a static error message at trace
+        # time — the standard (and harmless) pattern; exempt them
+        raise_fmt = {
+            id(n) for r in ast.walk(info.node) if isinstance(r, ast.Raise)
+            for n in ast.walk(r) if isinstance(n, ast.FormattedValue)}
+        for node in _own_nodes(info, index):
+            if isinstance(node, ast.Call):
+                self_check_call(node, info, index, local, tracers, emit)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Subscript,
+                                            ast.Attribute)):
+                        base = base.value
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(base, ast.Name) \
+                            and base.id not in local \
+                            and base.id not in index.module_aliases:
+                        emit("trace-mutate-capture", node, info,
+                             f"subscript assignment mutates captured "
+                             f"{base.id!r} at trace time")
+            elif isinstance(node, ast.Global) and node.names:
+                emit("trace-mutate-capture", node, info,
+                     f"global mutation of {', '.join(node.names)} "
+                     f"inside a traced function")
+            elif isinstance(node, ast.FormattedValue):
+                v = node.value
+                if id(node) not in raise_fmt \
+                        and isinstance(v, ast.Name) and v.id in tracers:
+                    emit("trace-tracer-leak", node, info,
+                         f"tracer parameter {v.id!r} interpolated into "
+                         f"an f-string (formats the tracer object, not "
+                         f"a value)")
+            if info.pallas_body:
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in ("float64", "int64") \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in index.numpy_aliases:
+                    emit("trace-f64-constant", node, info,
+                         f"{node.value.id}.{node.attr} inside a Pallas "
+                         f"kernel body")
+                elif isinstance(node, ast.Constant) \
+                        and node.value in ("float64", "int64"):
+                    emit("trace-f64-constant", node, info,
+                         f"dtype string {node.value!r} inside a Pallas "
+                         f"kernel body")
+    return findings
+
+
+def self_check_call(node: ast.Call, info: FnInfo, index: _Index,
+                    local: Set[str], tracers: Set[str], emit) -> None:
+    dotted = _dotted(node.func)
+    if dotted is None:
+        # method call f().g() etc: still check mutator-on-captured-name
+        return _check_mutator(node, info, index, local, emit)
+    parts = dotted.split(".")
+    base, leaf = parts[0], parts[-1]
+    # side effects
+    if dotted in ("print", "input", "open"):
+        emit("trace-side-effect", node, info,
+             f"{dotted}() inside a traced function")
+        return
+    if base in index.time_aliases and len(parts) == 2 \
+            and leaf in _TIME_FNS:
+        emit("trace-side-effect", node, info,
+             f"{dotted}() reads the host clock at trace time")
+        return
+    if base in index.random_aliases and len(parts) >= 2:
+        emit("trace-side-effect", node, info,
+             f"stdlib random ({dotted}) inside a traced function — "
+             f"use jax.random with an explicit key")
+        return
+    if base in index.numpy_aliases and len(parts) >= 3 \
+            and parts[1] == "random":
+        emit("trace-side-effect", node, info,
+             f"numpy RNG ({dotted}) burns one draw into the trace — "
+             f"use jax.random with an explicit key")
+        return
+    if base in index.os_aliases and leaf == "urandom":
+        emit("trace-side-effect", node, info,
+             f"{dotted}() inside a traced function")
+        return
+    fi = index.from_imports.get(dotted)
+    if fi is not None:
+        srcmod, orig = fi
+        if srcmod == "time" and orig in _TIME_FNS:
+            emit("trace-side-effect", node, info,
+                 f"{orig}() (from time) reads the host clock at trace "
+                 f"time")
+            return
+        if srcmod == "random":
+            emit("trace-side-effect", node, info,
+                 f"{orig}() (from random) inside a traced function")
+            return
+    # tracer leaks
+    if dotted in ("bool", "int", "float") and len(node.args) == 1 \
+            and isinstance(node.args[0], ast.Name) \
+            and node.args[0].id in tracers:
+        emit("trace-tracer-leak", node, info,
+             f"{dotted}() coerces tracer parameter "
+             f"{node.args[0].id!r} to a host value")
+        return
+    if isinstance(node.func, ast.Attribute) and leaf == "item" \
+            and not node.args:
+        emit("trace-tracer-leak", node, info,
+             ".item() pulls a device value to host under trace")
+        return
+    _check_mutator(node, info, index, local, emit)
+
+
+def _check_mutator(node: ast.Call, info: FnInfo, index: _Index,
+                   local: Set[str], emit) -> None:
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _MUTATORS):
+        return
+    base = f.value
+    if isinstance(base, ast.Name) and base.id not in local \
+            and base.id not in index.module_aliases:
+        emit("trace-mutate-capture", node, info,
+             f"{base.id}.{f.attr}() mutates a captured container at "
+             f"trace time")

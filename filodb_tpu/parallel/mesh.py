@@ -46,6 +46,7 @@ else:                                                   # jax <= 0.4.x
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_rep=check_vma)
 
+from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.query.model import RangeParams, RawSeries
 from filodb_tpu.query.tpu import (_GATHER_FUNCS, _TS_PAD, TpuBackend,
                                   _window_endpoint, _window_gather,
@@ -108,6 +109,29 @@ def pack_sharded(series_by_shard: Sequence[Sequence[RawSeries]],
     return ts_pad, vals_pad, lens, keys
 
 
+def _grouped_reduce_check():
+    """Abstract check under a minimal 1-device ('shard','time') mesh:
+    shard_map traces on CPU, nothing executes."""
+    devs = np.asarray(jax.devices()[:1]).reshape(1, 1)
+    mesh = Mesh(devs, ("shard", "time"))
+    S, T, G = 8, 16, 4
+    f = _shard_map(
+        lambda loc, g: _grouped_reduce(loc, g, G, "sum"),
+        mesh=mesh, in_specs=(P("shard", None), P("shard")),
+        out_specs=P(), check_vma=False)
+    out = jax.eval_shape(f, jax.ShapeDtypeStruct((S, T), jnp.float64),
+                         jax.ShapeDtypeStruct((S,), jnp.int32))
+    if tuple(out.shape) != (G, T) or str(out.dtype) != "float64":
+        return f"grouped reduce {out.shape}/{out.dtype} != ({G},{T}) f64"
+    return None
+
+
+@kernel_contract(
+    "mesh_grouped_reduce", kind="shard_map",
+    check=_grouped_reduce_check,
+    notes="per-device one-hot [S,G] matmul / segment min-max, then "
+          "psum/pmin/pmax over the 'shard' axis — ReduceAggregateExec "
+          "as a collective; requires a ('shard','time') mesh context")
 def _grouped_reduce(local: jnp.ndarray, gids: jnp.ndarray, num_groups: int,
                     agg: str) -> jnp.ndarray:
     """[S,T] per-series windowed results + [S] group ids → [G,T] partial

@@ -36,6 +36,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
+from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.query.model import QueryError
 
 
@@ -112,6 +113,7 @@ class RetryPolicy:
         return d * (1.0 - self.jitter * rng())
 
 
+@guarded_by("_lock", "_state", "_failures", "_opened_at")
 class CircuitBreaker:
     """Per-peer transport circuit breaker (CLOSED -> OPEN -> HALF_OPEN).
 
@@ -170,6 +172,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
 
 
+@guarded_by("_lock", "_breakers", "_retry_stats")
 class BreakerRegistry:
     """Address-keyed breaker map. One registry per server process (the
     HTTP server owns it), shared across queries so breaker state
@@ -182,6 +185,11 @@ class BreakerRegistry:
         self.reset_timeout_s = float(reset_timeout_s)
         self._lock = threading.Lock()
         self._breakers: Dict[str, CircuitBreaker] = {}
+        # per-peer call-policy counters surfaced in /metrics:
+        # attempts (dials tried), retries (re-dials after transport
+        # failure), exhaustions (gave up with retries spent), rejections
+        # (not dialed: breaker open)
+        self._retry_stats: Dict[str, Dict[str, int]] = {}
 
     def get(self, key: str) -> CircuitBreaker:
         with self._lock:
@@ -192,9 +200,34 @@ class BreakerRegistry:
                 self._breakers[key] = b
             return b
 
+    def record(self, key: str, counter: str, n: int = 1) -> None:
+        with self._lock:
+            st = self._retry_stats.setdefault(
+                key, {"attempts": 0, "retries": 0, "exhaustions": 0,
+                      "rejections": 0})
+            st[counter] = st.get(counter, 0) + n
+
+    def metrics_snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Per-peer view for the /metrics exposition: breaker state +
+        retry counters. Breaker state reads take each breaker's own
+        lock AFTER the registry lock is released (fixed order, no
+        nesting)."""
+        with self._lock:
+            breakers = dict(self._breakers)
+            stats = {k: dict(v) for k, v in self._retry_stats.items()}
+        out: Dict[str, Dict[str, object]] = {}
+        for key in set(breakers) | set(stats):
+            entry: Dict[str, object] = dict(stats.get(key, {}))
+            b = breakers.get(key)
+            if b is not None:
+                entry["state"] = b.state
+            out[key] = entry
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self._breakers.clear()
+            self._retry_stats.clear()
 
 
 DEFAULT_BREAKERS = BreakerRegistry()
@@ -227,13 +260,16 @@ def resilient_call(do_call: Callable[[float], object], *,
     the deadline budget; peer application errors pass straight through
     (the peer answered — retrying repeats the same error)."""
     retry = retry or RetryPolicy()
-    breaker = (breakers or DEFAULT_BREAKERS).get(key)
+    registry = breakers or DEFAULT_BREAKERS
+    breaker = registry.get(key)
     if not breaker.allow():
+        registry.record(key, "rejections")
         raise BreakerOpenError(
             f"peer {node_id} ({key}) circuit breaker is open")
     attempt = 0
     while True:
         attempt += 1
+        registry.record(key, "attempts")
         if deadline is not None:
             deadline.check(f"call to peer {node_id}")
         t = deadline.clip(timeout_s) if deadline is not None \
@@ -243,13 +279,16 @@ def resilient_call(do_call: Callable[[float], object], *,
         except TransportError:
             breaker.record_failure()
             if attempt >= retry.max_attempts or not breaker.allow():
+                registry.record(key, "exhaustions")
                 raise
             d = retry.delay_s(attempt)
             if deadline is not None:
                 rem = deadline.remaining()
                 if rem <= 0:
+                    registry.record(key, "exhaustions")
                     raise
                 d = min(d, max(rem - 1e-3, 0.0))
+            registry.record(key, "retries")
             if d > 0:
                 sleep(d)
             continue

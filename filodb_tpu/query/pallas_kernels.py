@@ -32,6 +32,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from filodb_tpu.lint.contracts import ANY, SEM, SMEM, Block, kernel_contract
+
 # jax dropped / moved the top-level enable_x64 context manager across
 # versions; resolve whichever this install provides
 if hasattr(jax, "enable_x64"):
@@ -378,6 +380,63 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
     jax.lax.fori_loop(0, n_ttiles, t_loop, None)
 
 
+def _groupsum_example():
+    """Abstract inputs for jax.eval_shape: st=1 / dspan=0 / both modes
+    GS_CUR is the single-stream configuration (mlen = 272)."""
+    g_perm = 512
+    args = ("rate", 1, 0, GS_CUR, GS_CUR,
+            jax.ShapeDtypeStruct((1, 1, g_perm, 3 * _GS_SS), jnp.int32),
+            jax.ShapeDtypeStruct((1, 8, _GS_SS), jnp.float32),
+            jax.ShapeDtypeStruct((_GS_SS, 16), jnp.float32),
+            1, 5_000, 5_000, 1_000, 256)
+    return args, {}
+
+
+def _groupsum_expect(out):
+    want = ((256, 16), jnp.float32)
+    for o in out:
+        if tuple(o.shape) != want[0] or o.dtype != want[1]:
+            return f"output {o.shape}/{o.dtype} != {want}"
+    return None
+
+
+# Worst-case on-chip footprint the tilestore dispatcher may admit (its
+# own cap is 14 MB): three DMA streams at the _GS_DSPAN_MAX merged
+# length, modest group count. The dispatcher trades streams against
+# [T, G] accumulator size; this declaration pins the largest shape on
+# the stream-heavy side of that frontier.
+@kernel_contract(
+    "counter_groupsum", kind="pallas",
+    grid=(8,),
+    blocks=(
+        Block("params", (5,), "int32", space=SMEM, tiled=False),
+        Block("v_p", (8, 2, 4096, 3 * _GS_SS), "int32", space=ANY),
+        Block("base", (1, 8, _GS_SS), "float32",
+              array_shape=(8, 8, _GS_SS),
+              index_map=lambda si: (si, 0, 0)),
+        Block("onehot", (_GS_SS, 256), "float32",
+              array_shape=(8 * _GS_SS, 256),
+              index_map=lambda si: (si, 0)),
+    ),
+    scratch=(
+        # double-buffered merged-stream DMA scratch: 2 slots x 3
+        # streams x mlen(st=2, dspan=48)=312 rows x 3 planes
+        Block("v_scr", (2, 3, 312, 3 * _GS_SS), "int32"),
+        Block("sems", (2, 3), "int32", space=SEM),
+    ),
+    outputs=(
+        Block("sums", (256, 256), "float32",
+              array_shape=(256, 256), index_map=lambda si: (0, 0)),
+        Block("cnts", (256, 256), "float32",
+              array_shape=(256, 256), index_map=lambda si: (0, 0)),
+    ),
+    vmem_budget=14 << 20,
+    rel_time_bits=31,
+    span_guard="filodb_tpu.query.tilestore:_slide_eligible",
+    example=_groupsum_example, expect=_groupsum_expect,
+    notes="dispatched only via tilestore.groupsum_counters, which "
+          "re-derives this footprint per query and falls back to the "
+          "general path above 14 MB")
 def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
                      lo_mode: int, v_p, base, onehot,
                      kl0, w0e_rel, window: int, step: int, nsteps: int,
@@ -506,6 +565,62 @@ def _extract_kernel(nchan: int, params_ref, tr_ref, pay_ref,
                 axis=2, dtype=jnp.float32)
 
 
+def _extract_example():
+    args = (jax.ShapeDtypeStruct((8, 2048), jnp.int32),
+            jax.ShapeDtypeStruct((8, 3, 2048), jnp.float32))
+    return args, {"step": 1_000, "window": 5_000, "nsteps": 128}
+
+
+def _extract_expect(out):
+    want = [((8, 128), jnp.int32)] * 3 + [((8, 3, 128), jnp.float32)] * 2
+    got = [(tuple(o.shape), o.dtype) for o in out]
+    if got != want:
+        return f"outputs {got} != {want}"
+    return None
+
+
+# Representative worst case: N = 2048 samples per row block. The [BS,
+# TC, N] mask temporaries dominate the footprint — they are compute
+# intermediates, declared here as scratch so the budget covers them.
+@kernel_contract(
+    "window_extract", kind="pallas",
+    grid=(4, 2),
+    blocks=(
+        Block("params", (1, 2), "int32", space=SMEM, tiled=False),
+        Block("tr", (_BS, 2048), "int32",
+              array_shape=(32, 2048), index_map=lambda i, j: (i, 0)),
+        # C=3 payload channels sit mid-block: Mosaic pads the sublane
+        # dim, so the (8,128) check is waived for this block
+        Block("pay", (_BS, 3, 2048), "float32", tiled=False,
+              array_shape=(32, 3, 2048),
+              index_map=lambda i, j: (i, 0, 0)),
+    ),
+    scratch=(
+        Block("mask_started", (_BS, _TC, 2048), "int32"),
+        Block("mask_after", (_BS, _TC, 2048), "int32"),
+        Block("onehot_edges", (_BS, _TC, 2048), "int32"),
+    ),
+    outputs=(
+        Block("cnt", (_BS, _TT), "int32",
+              array_shape=(32, 256), index_map=lambda i, j: (i, j)),
+        Block("t_lo", (_BS, _TT), "int32",
+              array_shape=(32, 256), index_map=lambda i, j: (i, j)),
+        Block("t_hi", (_BS, _TT), "int32",
+              array_shape=(32, 256), index_map=lambda i, j: (i, j)),
+        Block("pay_lo", (_BS, 3, _TT), "float32", tiled=False,
+              array_shape=(32, 3, 256),
+              index_map=lambda i, j: (i, 0, j)),
+        Block("pay_hi", (_BS, 3, _TT), "float32", tiled=False,
+              array_shape=(32, 3, 256),
+              index_map=lambda i, j: (i, 0, j)),
+    ),
+    vmem_budget=8 << 20,
+    rel_time_bits=31,
+    span_guard="filodb_tpu.query.tpu:_window_endpoint_pallas",
+    example=_extract_example, expect=_extract_expect,
+    notes="rate-family boundary extraction for irregular series; "
+          "timestamps are int32 offsets from the first window start "
+          "(TR_PAD sentinel for padding)")
 def window_extract(tr: jnp.ndarray, pay: jnp.ndarray,
                    step, window, nsteps: int,
                    interpret: bool = False

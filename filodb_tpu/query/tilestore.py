@@ -38,6 +38,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.query.model import RawSeries
 
 # functions servable from aligned tiles (everything endpoint- or
@@ -1065,6 +1066,12 @@ def _slide_eligible(tiles: AlignedTiles, nsteps: int, w0s: int, w0e: int,
     return st, k_c0, k_l0
 
 
+@kernel_contract(
+    "counters_t_dispatch", kind="dispatch",
+    rel_time_bits=31, span_guard="_slide_eligible",
+    notes="transposed counter fast path: slide evaluator when "
+          "_slide_eligible proves the regular interior grid, f32-hybrid "
+          "when the span fits int31 ms, exact all-f64 otherwise")
 def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
                         window_ms: int, offset_ms: int = 0) -> jnp.ndarray:
     """rate/increase/delta on the transposed fast path → [T, S].
@@ -1117,6 +1124,15 @@ def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
               jnp.asarray(w0s), jnp.asarray(w0e), jnp.asarray(step))
 
 
+@kernel_contract(
+    "groupsum_dispatch", kind="dispatch",
+    vmem_budget=14 << 20,
+    rel_time_bits=31, span_guard="_slide_eligible",
+    notes="host-side gate for the fused Pallas group-sum kernel: "
+          "regular interior grid via _slide_eligible, merged-stream "
+          "window/step divisibility, dspan cap, full VMEM re-budget "
+          "(accumulators + DMA scratch + onehot + base), Mosaic "
+          "compile backstop falls back to the general path")
 def groupsum_counters(tiles: AlignedTiles, func: str, steps: np.ndarray,
                       window_ms: int, onehot, offset_ms: int = 0,
                       interpret: bool = False):

@@ -41,7 +41,30 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _tile_example(extra=(), nsteps=16, S=8, N=64):
+    """Shared [S, N] tile example for the windowed-kernel contracts."""
+    args = (*extra,
+            _sds((S, N), jnp.int64), _sds((S, N), jnp.float64),
+            _sds((S,), jnp.int32),
+            _sds((), jnp.int64), _sds((), jnp.int64),
+            _sds((), jnp.int64), nsteps, _sds((), jnp.float64))
+    return args, {}
+
+
+def _grid_expect(S, T):
+    def expect(out):
+        if tuple(out.shape) != (S, T) or str(out.dtype) != "float64":
+            return f"output {out.shape}/{out.dtype} != ({S}, {T}) f64"
+        return None
+    return expect
 
 # sentinel timestamp for padding: larger than any real ms timestamp
 _TS_PAD = np.int64(1) << 60
@@ -192,6 +215,12 @@ def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
     return jnp.where(counts >= 2, scaled, jnp.nan)
 
 
+@kernel_contract(
+    "window_endpoint", kind="jit",
+    example=lambda: _tile_example(extra=("rate",)),
+    expect=_grid_expect(8, 16),
+    notes="endpoint + prefix-sum family over [S, N] i64/f64 tiles; "
+          "uniform window grid, output [S, T] f64")
 @functools.partial(jax.jit, static_argnames=("func", "nsteps"))
 def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
                      step, nsteps, scalar):
@@ -286,6 +315,12 @@ def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
     return jnp.where(has, out, nan)
 
 
+@kernel_contract(
+    "window_gather", kind="jit",
+    example=lambda: _tile_example(extra=("min_over_time", 8)),
+    expect=_grid_expect(8, 16),
+    notes="order-statistic family: [S, T, W] bounded gather, W static; "
+          "the [S*T*W] intermediate is XLA-managed HBM, not VMEM")
 @functools.partial(jax.jit, static_argnames=("func", "w_bound", "nsteps"))
 def _window_gather(func: str, w_bound: int, ts, vals, lens, w0s, w0e,
                    step, nsteps, scalar):
@@ -336,6 +371,18 @@ _GATHER_FUNCS = frozenset({"min_over_time", "max_over_time",
 _PALLAS_FUNCS = frozenset({"rate", "increase", "delta"})
 
 
+@kernel_contract(
+    "pallas_rate", kind="jit",
+    example=lambda: (
+        ("rate", 128, False,
+         _sds((8, 128), jnp.int64), _sds((8, 128), jnp.float64),
+         _sds((8,), jnp.int32), _sds((), jnp.int64),
+         _sds((), jnp.int64), _sds((), jnp.int64)), {}),
+    expect=_grid_expect(8, 128),
+    rel_time_bits=31, span_guard="_window_endpoint_pallas",
+    notes="irregular-cadence rate family: counter correction + exact "
+          "f64->3xf32 split feeding the Pallas boundary-extract kernel; "
+          "timestamps rebased to w0s must fit int31 ms")
 @functools.partial(jax.jit, static_argnames=("func", "nsteps", "interpret"))
 def _pallas_rate_impl(func, nsteps, interpret, ts, vals, lens, w0s, w0e,
                       step):

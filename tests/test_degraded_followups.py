@@ -1,0 +1,119 @@
+"""PR-1 follow-ups: breaker/retry counters in /metrics, and
+partial+warnings over the gRPC exec wire (matching the HTTP plane)."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.grpcsvc import wire
+from filodb_tpu.parallel.resilience import (BreakerRegistry, RetryPolicy,
+                                            TransportError, resilient_call)
+from filodb_tpu.query.model import GridResult, QueryStats
+
+
+# -- breaker/retry counters --------------------------------------------------
+
+def test_registry_counts_attempts_retries_exhaustions():
+    reg = BreakerRegistry(failure_threshold=10)
+
+    def always_down(timeout_s):
+        raise TransportError("nope")
+
+    with pytest.raises(TransportError):
+        resilient_call(always_down, key="peer:1", node_id="n1",
+                       timeout_s=1.0, retry=RetryPolicy(max_attempts=3),
+                       breakers=reg, sleep=lambda s: None)
+    snap = reg.metrics_snapshot()["peer:1"]
+    assert snap["attempts"] == 3
+    assert snap["retries"] == 2
+    assert snap["exhaustions"] == 1
+    assert snap["state"] == "closed"    # threshold 10 not reached
+
+
+def test_registry_counts_breaker_rejections():
+    from filodb_tpu.parallel.resilience import BreakerOpenError
+    reg = BreakerRegistry(failure_threshold=1, reset_timeout_s=60.0)
+
+    def always_down(timeout_s):
+        raise TransportError("nope")
+
+    with pytest.raises(TransportError):
+        resilient_call(always_down, key="p", node_id="n",
+                       timeout_s=1.0, retry=RetryPolicy(max_attempts=1),
+                       breakers=reg, sleep=lambda s: None)
+    with pytest.raises(BreakerOpenError):
+        resilient_call(always_down, key="p", node_id="n",
+                       timeout_s=1.0, breakers=reg, sleep=lambda s: None)
+    snap = reg.metrics_snapshot()["p"]
+    assert snap["state"] == "open"
+    assert snap["rejections"] == 1
+
+
+def test_metrics_exposition_includes_breaker_and_retry_counters():
+    from filodb_tpu.http.server import FiloHttpServer
+    from filodb_tpu.parallel.resilience import PeerResilience
+    reg = BreakerRegistry()
+    reg.record("peer:9", "attempts", 4)
+    reg.record("peer:9", "retries", 2)
+    reg.get("peer:9")           # materialize a breaker (closed)
+    srv = FiloHttpServer({"ds": []},
+                         resilience=PeerResilience(RetryPolicy(), reg))
+    try:
+        text = srv._metrics_text()
+    finally:
+        srv.httpd.server_close()
+    assert 'filodb_breaker_state{peer="peer:9",state="closed"} 1' in text
+    assert 'filodb_peer_call_attempts_total{peer="peer:9"} 4' in text
+    assert 'filodb_peer_call_retries_total{peer="peer:9"} 2' in text
+
+
+# -- partial/warnings over the gRPC exec wire --------------------------------
+
+def _grid(partial=False, warnings=()):
+    return GridResult(np.array([1000, 2000], np.int64),
+                      [{"job": "a"}], np.array([[1.0, 2.0]]),
+                      partial=partial, warnings=list(warnings))
+
+
+def test_exec_wire_roundtrip_partial_warnings():
+    st = QueryStats()
+    st.partial = True
+    st.warnings = ["shard group 2 dropped (breaker open)"]
+    buf = wire.encode_exec_response(
+        _grid(partial=False, warnings=["adopter still bootstrapping"]),
+        stats=st)
+    _, _, _, _, _, stats, err = wire.decode_exec_response(buf)
+    assert not err
+    assert stats["partial"] is True
+    assert stats["warnings"] == ["adopter still bootstrapping",
+                                 "shard group 2 dropped (breaker open)"]
+
+
+def test_exec_wire_clean_response_has_no_markers():
+    buf = wire.encode_exec_response(_grid(), stats=QueryStats())
+    _, _, _, _, _, stats, err = wire.decode_exec_response(buf)
+    assert stats["partial"] is False and stats["warnings"] == []
+
+
+def test_grpc_remote_exec_propagates_markers(monkeypatch):
+    from filodb_tpu.grpcsvc import client as gclient
+    payload = wire.encode_exec_response(
+        _grid(partial=True, warnings=["peer n2: shard 1 missing"]),
+        stats=QueryStats())
+    monkeypatch.setattr(
+        gclient, "_call",
+        lambda addr, method, body, timeout_s, node_id: payload)
+    st = QueryStats()
+    ex = gclient.GrpcRemoteExec(
+        "sum(x)", 1000, 1000, 2000, node_id="n2",
+        addr="127.0.0.1:1", dataset="ds", stats=st)
+    grid = ex.execute()
+    assert grid.partial is True
+    assert grid.warnings == ["peer n2: shard 1 missing"]
+    assert st.partial is True
+    assert st.warnings == ["peer n2: shard 1 missing"]
+    # the HTTP envelope then surfaces them, same as the HTTP plane
+    from filodb_tpu.http import prom_json
+    out = prom_json.attach_degraded(
+        prom_json.matrix(grid), grid, st)
+    assert out["partial"] is True
+    assert out["warnings"] == ["peer n2: shard 1 missing"]

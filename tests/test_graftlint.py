@@ -1,0 +1,408 @@
+"""graftlint rule fixtures: each violation snippet trips exactly one
+rule; each clean twin passes. Plus contract-object checks (VMEM budget,
+tiling, grid bounds, span guard, abstract eval) and CLI exit codes."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+from filodb_tpu.lint import run_lint
+from filodb_tpu.lint.contracts import (Block, KernelContract,
+                                       kernel_contract)
+from filodb_tpu.lint.rules_kernel import check_contract
+
+
+def lint_src(tmp_path, src, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    res = run_lint([str(p)], baseline=frozenset(), check_contracts=False)
+    return res
+
+
+def rules_of(res):
+    return sorted({f.rule for f in res.findings})
+
+
+# -- trace safety ------------------------------------------------------------
+
+TRACE_SIDE_EFFECT = """
+import functools, time
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x + time.time()
+"""
+
+TRACE_SIDE_EFFECT_CLEAN = """
+import functools, time
+import jax
+
+def now():
+    return time.time()          # host helper, never traced
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x * n
+"""
+
+
+def test_trace_side_effect(tmp_path):
+    assert rules_of(lint_src(tmp_path, TRACE_SIDE_EFFECT)) \
+        == ["trace-side-effect"]
+    assert not lint_src(tmp_path, TRACE_SIDE_EFFECT_CLEAN).findings
+
+
+TRACE_TRACER_LEAK = """
+import jax
+
+@jax.jit
+def f(x):
+    return 1.0 if bool(x) else 0.0
+"""
+
+TRACE_TRACER_LEAK_CLEAN = """
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def f(x, flag):
+    return jnp.where(bool(flag), x, -x)   # static param: fine
+"""
+
+
+def test_trace_tracer_leak(tmp_path):
+    assert rules_of(lint_src(tmp_path, TRACE_TRACER_LEAK)) \
+        == ["trace-tracer-leak"]
+    assert not lint_src(tmp_path, TRACE_TRACER_LEAK_CLEAN).findings
+
+
+TRACE_MUTATE = """
+import jax
+
+_seen = []
+
+@jax.jit
+def f(x):
+    _seen.append(x)
+    return x
+"""
+
+TRACE_MUTATE_CLEAN = """
+import jax
+
+@jax.jit
+def f(x):
+    acc = []
+    acc.append(x)               # function-local: fine
+    return acc[0]
+"""
+
+
+def test_trace_mutate_capture(tmp_path):
+    assert rules_of(lint_src(tmp_path, TRACE_MUTATE)) \
+        == ["trace-mutate-capture"]
+    assert not lint_src(tmp_path, TRACE_MUTATE_CLEAN).findings
+
+
+TRACE_F64 = """
+import jax.numpy as jnp
+
+def kern(x_ref, o_ref):
+    o_ref[:] = x_ref[:].astype(jnp.float64)
+"""
+
+TRACE_F64_CLEAN = """
+import jax.numpy as jnp
+
+def kern(x_ref, o_ref):
+    o_ref[:] = x_ref[:].astype(jnp.float32)
+
+def host_helper(x):
+    return x.astype(jnp.float64)    # not a kernel body: fine
+"""
+
+
+def test_trace_f64_in_pallas_body(tmp_path):
+    assert rules_of(lint_src(tmp_path, TRACE_F64)) \
+        == ["trace-f64-constant"]
+    assert not lint_src(tmp_path, TRACE_F64_CLEAN).findings
+
+
+# -- kernel contracts (AST) --------------------------------------------------
+
+CONTRACT_MISSING = """
+from jax.experimental import pallas as pl
+import jax
+import jax.numpy as jnp
+
+def run(x):
+    def kern(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+    return pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+CONTRACT_PRESENT = """
+from jax.experimental import pallas as pl
+import jax
+import jax.numpy as jnp
+from filodb_tpu.lint.contracts import kernel_contract
+
+@kernel_contract("toy", kind="pallas", vmem_budget=1 << 20)
+def run(x):
+    def kern(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+    return pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+
+
+def test_kernel_contract_missing(tmp_path):
+    assert rules_of(lint_src(tmp_path, CONTRACT_MISSING)) \
+        == ["kernel-contract-missing"]
+    assert not lint_src(tmp_path, CONTRACT_PRESENT).findings
+
+
+# -- lock discipline ---------------------------------------------------------
+
+LOCK_ACCESS = """
+import threading
+from filodb_tpu.lint.locks import guarded_by
+
+@guarded_by("_lock", "_items")
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def bad(self, x):
+        self._items.append(x)
+"""
+
+LOCK_ACCESS_CLEAN = """
+import threading
+from filodb_tpu.lint.locks import guarded_by
+
+@guarded_by("_lock", "_items")
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def good(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drain_locked(self):
+        return list(self._items)    # *_locked: caller holds the lock
+"""
+
+
+def test_lock_guarded_access(tmp_path):
+    assert rules_of(lint_src(tmp_path, LOCK_ACCESS)) \
+        == ["lock-guarded-access"]
+    assert not lint_src(tmp_path, LOCK_ACCESS_CLEAN).findings
+
+
+LOCK_BLOCKING = """
+import threading
+import time
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(1.0)
+"""
+
+LOCK_BLOCKING_CLEAN = """
+import threading
+import time
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fine(self):
+        with self._lock:
+            n = 1 + 1
+        time.sleep(0.0)             # outside the lock
+        return n
+"""
+
+
+def test_lock_blocking_call(tmp_path):
+    assert rules_of(lint_src(tmp_path, LOCK_BLOCKING)) \
+        == ["lock-blocking-call"]
+    assert not lint_src(tmp_path, LOCK_BLOCKING_CLEAN).findings
+
+
+LOCK_MODULE_GLOBAL = """
+import threading
+
+_cache = {}
+_cache_lock = threading.Lock()
+__guarded_by__ = {"_cache": "_cache_lock"}
+
+def bad(k):
+    return _cache.get(k)
+"""
+
+
+def test_lock_module_global(tmp_path):
+    assert rules_of(lint_src(tmp_path, LOCK_MODULE_GLOBAL)) \
+        == ["lock-guarded-access"]
+
+
+# -- pragmas and baseline ----------------------------------------------------
+
+PRAGMA_OK = """
+import functools, time
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    # graftlint: disable=trace-side-effect (bench-only trace timestamp)
+    return x + time.time()
+"""
+
+PRAGMA_NO_REASON = """
+import functools, time
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x + time.time()  # graftlint: disable=trace-side-effect
+"""
+
+
+def test_pragma_suppression(tmp_path):
+    res = lint_src(tmp_path, PRAGMA_OK)
+    assert not res.findings and res.suppressed == 1
+
+
+def test_pragma_requires_reason(tmp_path):
+    assert rules_of(lint_src(tmp_path, PRAGMA_NO_REASON)) \
+        == ["pragma-no-reason"]
+
+
+def test_baseline_grandfathers(tmp_path):
+    res = lint_src(tmp_path, TRACE_SIDE_EFFECT)
+    assert len(res.findings) == 1
+    key = res.findings[0].key()
+    p = tmp_path / "fixture.py"
+    res2 = run_lint([str(p)], baseline=frozenset([key]),
+                    check_contracts=False)
+    assert not res2.findings and len(res2.baselined) == 1
+
+
+# -- contract object checks (no execution) -----------------------------------
+
+def _unrunnable(*a, **k):
+    raise AssertionError("contract checks must never execute the kernel")
+
+
+def test_vmem_budget_catches_oversized_kernel():
+    """The acceptance fixture: a deliberately oversized kernel is caught
+    by block arithmetic alone — the kernel body would assert if run."""
+    c = KernelContract(
+        name="oversized", kind="pallas", fn=_unrunnable, module="x",
+        qualname="oversized",
+        blocks=(Block("x", (8, 128), "float32"),),
+        scratch=(Block("s", (8, 2048, 1024), "float32"),),  # 64 MB
+        vmem_budget=14 << 20)
+    rules = [f.rule for f in check_contract(c, "x.py")]
+    assert rules == ["kernel-vmem-budget"]
+
+
+def test_vmem_budget_required_for_pallas():
+    c = KernelContract(name="nobudget", kind="pallas", fn=_unrunnable,
+                       module="x", qualname="nobudget")
+    assert "kernel-vmem-budget" in [f.rule for f in check_contract(c)]
+
+
+def test_tile_alignment():
+    bad = KernelContract(
+        name="tiles", kind="pallas", fn=_unrunnable, module="x",
+        qualname="tiles", vmem_budget=1 << 20,
+        blocks=(Block("a", (7, 128), "float32"),     # sublane 7 % 8
+                Block("b", (8, 100), "float32"),     # lane 100 % 128
+                Block("c", (16, 128), "bfloat16"),   # ok: 16 % 16
+                Block("d", (8, 128), "float64")))    # 8-byte in VMEM
+    rules = sorted(f.rule for f in check_contract(bad))
+    assert rules == ["kernel-tile-alignment"] * 3
+
+
+def test_grid_bounds():
+    bad = KernelContract(
+        name="grid", kind="pallas", fn=_unrunnable, module="x",
+        qualname="grid", vmem_budget=1 << 20, grid=(4,),
+        blocks=(Block("a", (8, 128), "float32",
+                      array_shape=(16, 128),     # only 2 blocks fit
+                      index_map=lambda i: (i, 0)),))
+    assert [f.rule for f in check_contract(bad)] == ["kernel-grid-bounds"]
+
+
+def test_span_guard_must_resolve():
+    bad = KernelContract(
+        name="span", kind="dispatch", fn=_unrunnable,
+        module="filodb_tpu.query.tilestore", qualname="span",
+        rel_time_bits=31, span_guard="_no_such_predicate")
+    assert [f.rule for f in check_contract(bad)] == ["kernel-span-guard"]
+    ok = KernelContract(
+        name="span2", kind="dispatch", fn=_unrunnable,
+        module="filodb_tpu.query.tilestore", qualname="span2",
+        rel_time_bits=31, span_guard="_slide_eligible")
+    assert not check_contract(ok)
+
+
+def test_abstract_eval_shape_mismatch():
+    def fn(x):
+        return x * 2.0
+
+    bad = KernelContract(
+        name="ev", kind="jit", fn=fn, module="x", qualname="ev",
+        example=lambda: ((jax.ShapeDtypeStruct((4, 4), jnp.float32),),
+                         {}),
+        expect=lambda out: None if tuple(out.shape) == (8, 8)
+        else f"got {out.shape}")
+    assert [f.rule for f in check_contract(bad)] \
+        == ["kernel-abstract-eval"]
+
+
+def test_decorator_registers_and_preserves_fn():
+    @kernel_contract("toy_reg", kind="jit", vmem_budget=None)
+    def fn(x):
+        return x
+
+    assert fn(3) == 3
+    assert fn.__kernel_contract__.name == "toy_reg"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path):
+    from filodb_tpu.lint.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(TRACE_SIDE_EFFECT)
+    good = tmp_path / "good.py"
+    good.write_text(TRACE_SIDE_EFFECT_CLEAN)
+    assert main(["--no-contracts", str(good)]) == 0
+    assert main(["--no-contracts", str(bad)]) == 1
+
+
+def test_cli_json_machine_readable(tmp_path, capsys):
+    from filodb_tpu.lint.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(TRACE_SIDE_EFFECT)
+    rc = main(["--no-contracts", "--json", str(bad)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["exit_code"] == 1
+    assert out["findings"][0]["rule"] == "trace-side-effect"
+    assert {"path", "line", "message", "severity"} <= \
+        set(out["findings"][0])
