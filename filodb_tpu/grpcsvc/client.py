@@ -119,14 +119,23 @@ class GrpcShardGroup:
             breakers=self.breakers, deadline=self.deadline,
             allow_partial=self.allow_partial)
 
+    def _deadline_ms(self) -> int:
+        """Caller's remaining budget, forwarded so the peer inherits it
+        (server-side deadline propagation); 0 = no deadline."""
+        if self.deadline is None:
+            return 0
+        return max(int(self.deadline.remaining() * 1000), 1)
+
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],
                   full: bool = True) -> List[RawSeries]:
-        payload = wire.encode_raw_request(
-            self.dataset, filters, start_ms, end_ms, column,
-            self.shard_nums, span_snap=bool(full))
-
         def dial(timeout_s: float) -> bytes:
+            # payload re-encoded per attempt: a retry must forward the
+            # REMAINING budget, not the original one
+            payload = wire.encode_raw_request(
+                self.dataset, filters, start_ms, end_ms, column,
+                self.shard_nums, span_snap=bool(full),
+                deadline_ms=self._deadline_ms())
             return _call(self.addr, "FetchRaw", payload, timeout_s,
                          self.node_id)
 
@@ -191,14 +200,21 @@ class GrpcRemoteExec:
             local_only=self.local_only, retry=self.retry,
             breakers=self.breakers, deadline=self.deadline)
 
+    def _deadline_ms(self) -> int:
+        if self.deadline is None:
+            return 0
+        return max(int(self.deadline.remaining() * 1000), 1)
+
     def execute(self):
         from filodb_tpu.query.model import GridResult, RangeParams
-        payload = wire.encode_exec_request(
-            self.dataset, self.query, self.start_ms, self.step_ms,
-            self.end_ms, local_only=self.local_only,
-            plan_wire=self.plan_wire)
 
         def dial(timeout_s: float) -> bytes:
+            # re-encoded per attempt: forward the REMAINING budget
+            payload = wire.encode_exec_request(
+                self.dataset, self.query, self.start_ms, self.step_ms,
+                self.end_ms, local_only=self.local_only,
+                plan_wire=self.plan_wire,
+                deadline_ms=self._deadline_ms())
             return _call(self.addr, "Exec", payload, timeout_s,
                          self.node_id)
 

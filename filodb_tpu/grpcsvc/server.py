@@ -70,6 +70,17 @@ class GrpcQueryServer:
 
     # -- RPC implementations ---------------------------------------------
 
+    @staticmethod
+    def _req_deadline(req, default_timeout_s: float):
+        """Server-side deadline propagation: the caller forwarded its
+        remaining budget; this node inherits it (clipped to the local
+        default so a buggy caller can't grant itself infinity)."""
+        from filodb_tpu.parallel.resilience import Deadline
+        ms = int(req.get("deadline_ms") or 0)
+        if ms <= 0:
+            return None
+        return Deadline.after(min(ms / 1000.0, default_timeout_s))
+
     def _fetch_raw(self, request: bytes, context) -> bytes:
         from filodb_tpu.query.model import QueryError, QueryStats
         with self._rpc_lock:
@@ -79,7 +90,9 @@ class GrpcQueryServer:
             series = self.http.leaf_select(
                 req["dataset"], req["filters"], req["start_ms"],
                 req["end_ms"], req["column"], req["shards"],
-                span_snap=req["span_snap"], stats=QueryStats())
+                span_snap=req["span_snap"], stats=QueryStats(),
+                deadline=self._req_deadline(
+                    req, getattr(self.http, "query_timeout_s", 30.0)))
             if series is None:
                 return wire.encode_raw_response(
                     [], error=f"dataset {req['dataset']} not set up")
@@ -100,7 +113,9 @@ class GrpcQueryServer:
         try:
             req = wire.decode_exec_request(request)
             engine = self.http.make_planner(
-                req["dataset"], local_dispatch=req["local_only"])
+                req["dataset"], local_dispatch=req["local_only"],
+                deadline=self._req_deadline(
+                    req, getattr(self.http, "query_timeout_s", 30.0)))
             if engine is None:
                 return wire.encode_exec_response(
                     None, error=f"dataset {req['dataset']} not set up")

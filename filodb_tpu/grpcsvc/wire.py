@@ -14,7 +14,8 @@ replace.
 Messages (field numbers):
   Filter        {1: label, 2: op, 3: value}
   RawRequest    {1: dataset, 2: Filter*, 3: start_ms, 4: end_ms,
-                 5: column, 6: shards packed, 7: span_snap}
+                 5: column, 6: shards packed, 7: span_snap,
+                 8: deadline_ms (caller's remaining budget; 0 = none)}
   SnapKey       {1: node, 2: ds, 3: shard, 4: part, 5: num_chunks,
                  6: col, 7: start_ms, 8: end_ms}
   Srv           {1: label entry {1:k,2:v}*, 2: n, 3: ts nibble,
@@ -22,7 +23,8 @@ Messages (field numbers):
                  8: drops nibble, 9: chunk_len+1, 10: SnapKey}
   RawResponse   {1: Srv*, 2: error}
   ExecRequest   {1: dataset, 2: query, 3: start_ms, 4: step_ms,
-                 5: end_ms, 6: local_only, 7: hist_wire}
+                 5: end_ms, 6: local_only, 7: hist_wire,
+                 9: deadline_ms (caller's remaining budget; 0 = none)}
   ExecSeries    {1: label entry*, 2: values nibble (grid-aligned,
                  NaN where absent), 3: hist nibble flat, 4: nb}
   ExecResponse  {1: ExecSeries*, 2: error, 3: steps nibble,
@@ -93,7 +95,8 @@ def _entry_dec(buf: bytes) -> Tuple[str, str]:
 def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
                        column: Optional[str],
                        shards: Optional[Sequence[int]],
-                       span_snap: bool = True) -> bytes:
+                       span_snap: bool = True,
+                       deadline_ms: int = 0) -> bytes:
     out = bytearray(_ld(1, dataset.encode()))
     for f in filters:
         out += _ld(2, _ld(1, f.label.encode()) + _ld(2, f.op.encode())
@@ -104,13 +107,16 @@ def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
     if shards is not None:
         out += _ld(6, b"".join(_uvarint(int(s)) for s in shards))
     out += _vi(7, 1 if span_snap else 0)
+    if deadline_ms > 0:
+        out += _vi(8, int(deadline_ms))
     return bytes(out)
 
 
 def decode_raw_request(buf: bytes) -> Dict:
     from filodb_tpu.core.index import ColumnFilter
     req = {"dataset": "", "filters": [], "start_ms": 0, "end_ms": 0,
-           "column": None, "shards": None, "span_snap": True}
+           "column": None, "shards": None, "span_snap": True,
+           "deadline_ms": 0}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -138,6 +144,8 @@ def decode_raw_request(buf: bytes) -> Dict:
             req["shards"] = shards
         elif f == 7:
             req["span_snap"] = bool(v)
+        elif f == 8:
+            req["deadline_ms"] = _signed(v)
     return req
 
 
@@ -255,21 +263,27 @@ def decode_raw_response(buf: bytes):
 def encode_exec_request(dataset: str, query: str, start_ms: int,
                         step_ms: int, end_ms: int,
                         local_only: bool = True,
-                        plan_wire: bytes = b"") -> bytes:
+                        plan_wire: bytes = b"",
+                        deadline_ms: int = 0) -> bytes:
     """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
     the reference's exec_plan.proto capability; the printed query text
-    stays alongside for debuggability and older peers."""
+    stays alongside for debuggability and older peers. Field 9 carries
+    the caller's remaining deadline budget in ms (server-side deadline
+    propagation; 0/absent = none)."""
     out = (_ld(1, dataset.encode()) + _ld(2, query.encode())
            + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
            + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
     if plan_wire:
         out += _ld(8, plan_wire)
+    if deadline_ms > 0:
+        out += _vi(9, int(deadline_ms))
     return out
 
 
 def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
-           "end_ms": 0, "local_only": True, "plan_wire": b""}
+           "end_ms": 0, "local_only": True, "plan_wire": b"",
+           "deadline_ms": 0}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -285,6 +299,8 @@ def decode_exec_request(buf: bytes) -> Dict:
             req["local_only"] = bool(v)
         elif f == 8:
             req["plan_wire"] = v
+        elif f == 9:
+            req["deadline_ms"] = _signed(v)
     return req
 
 

@@ -8,6 +8,7 @@ Prometheus does.)"""
 
 from __future__ import annotations
 
+import json
 import math
 from typing import Any, Dict, List
 
@@ -24,6 +25,39 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+# shortest-roundtrip float texts memoized across requests: metric
+# streams repeat values heavily (constant rates, integer gauges), and a
+# dict hit is ~10x cheaper than repr. Bounded by reset; no lock — a
+# lost race just recomputes the same string (CPython dict ops are
+# atomic; values are pure functions of the key).
+_FMT_MEMO: Dict[float, str] = {}
+_FMT_MEMO_MAX = 65536
+
+
+def _fmt_row(steps_s: np.ndarray, row: np.ndarray, ok: np.ndarray
+             ) -> List[List]:
+    """Vectorized [ts, "value"] pairs for one matrix row (the serving
+    fast path's JSON encode: per-element math.isnan/isinf checks in
+    Python dominated the encode cost). ``tolist()`` converts in C; the
+    per-element ``repr`` of a Python float is the same shortest-roundtrip
+    text ``_fmt`` produces; rows with infinities (rare) fall back to
+    ``_fmt`` for the +Inf/-Inf spellings."""
+    vals = row[ok]
+    ts = steps_s[ok].tolist()
+    if np.isinf(vals).any():
+        return [[t, _fmt(v)] for t, v in zip(ts, vals.tolist())]
+    memo = _FMT_MEMO
+    if len(memo) > _FMT_MEMO_MAX:
+        memo.clear()
+    out = []
+    for t, v in zip(ts, vals.tolist()):
+        s = memo.get(v)
+        if s is None:
+            memo[v] = s = repr(v)
+        out.append([t, s])
+    return out
+
+
 def success(data: Any) -> Dict:
     return {"status": "success", "data": data}
 
@@ -31,6 +65,80 @@ def success(data: Any) -> Dict:
 def error(message: str, error_type: str = "bad_data",
           status: str = "error") -> Dict:
     return {"status": status, "errorType": error_type, "error": message}
+
+
+class PreEncoded:
+    """Response payload already serialized to JSON bytes (the serving
+    fast path skips the dict -> json.dumps walk for bulk matrix data);
+    the HTTP edge sends ``body`` verbatim with ``ctype``."""
+
+    __slots__ = ("body", "ctype")
+
+    def __init__(self, body: bytes,
+                 ctype: str = "application/json"):
+        self.body = body
+        self.ctype = ctype
+
+
+# timestamps repeat across queries (step grids) and values repeat across
+# steps (constant rates, integer gauges): memoized fragments make the
+# bulk encode mostly dict lookups. Unlocked by design — racing writers
+# recompute identical strings (CPython dict ops are atomic).
+_TS_MEMO: Dict[float, str] = {}
+
+
+def _ts_frag(t: float) -> str:
+    s = _TS_MEMO.get(t)
+    if s is None:
+        if len(_TS_MEMO) > _FMT_MEMO_MAX:
+            _TS_MEMO.clear()
+        _TS_MEMO[t] = s = repr(t)
+    return s
+
+
+def matrix_bytes(grid: GridResult, stats_json: Dict,
+                 warnings=None, partial: bool = False) -> PreEncoded:
+    """Serving fast path: a range-query matrix response encoded straight
+    to JSON bytes. Byte-identical to ``json.dumps(matrix(grid)
+    [+stats/degraded], separators=(",", ":"))`` — pinned by
+    tests/test_http_e2e-style golden comparisons in test_plancache.
+
+    Only the plain scalar-matrix shape takes this path (histogram wire
+    and scalar results keep the dict path)."""
+    rows: List[str] = []
+    steps_s = grid.steps / 1000.0
+    memo = _FMT_MEMO
+    if len(memo) > _FMT_MEMO_MAX:
+        memo.clear()
+    for i, key in enumerate(grid.keys):
+        row = grid.values[i]
+        ok = ~np.isnan(row)
+        if not ok.any():
+            continue
+        vals = row[ok]
+        ts = steps_s[ok].tolist()
+        metric = json.dumps(_metric(key), separators=(",", ":"))
+        if np.isinf(vals).any():
+            frags = [f'[{_ts_frag(t)},"{_fmt(v)}"]'
+                     for t, v in zip(ts, vals.tolist())]
+        else:
+            frags = []
+            for t, v in zip(ts, vals.tolist()):
+                s = memo.get(v)
+                if s is None:
+                    memo[v] = s = repr(v)
+                frags.append(f'[{_ts_frag(t)},"{s}"]')
+        rows.append('{"metric":%s,"values":[%s]}'
+                    % (metric, ",".join(frags)))
+    tail = ',"stats":' + json.dumps(stats_json, separators=(",", ":"))
+    if warnings:
+        tail += ',"warnings":' + json.dumps(sorted(set(warnings)),
+                                            separators=(",", ":"))
+    if partial:
+        tail += ',"partial":true'
+    body = ('{"status":"success","data":{"resultType":"matrix",'
+            '"result":[' + ",".join(rows) + "]}" + tail + "}")
+    return PreEncoded(body.encode())
 
 
 def matrix(grid: GridResult, hist_wire: bool = False) -> Dict:
@@ -47,8 +155,7 @@ def matrix(grid: GridResult, hist_wire: bool = False) -> Dict:
         ok = ~np.isnan(row)
         entry = None
         if ok.any():
-            values = [[float(t), _fmt(v)]
-                      for t, v, o in zip(steps_s, row, ok) if o]
+            values = _fmt_row(steps_s, row, ok)
             entry = {"metric": _metric(key), "values": values}
         if hist_wire and grid.is_hist():
             import base64

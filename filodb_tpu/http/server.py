@@ -41,6 +41,17 @@ class _Handled(Exception):
     """Control-flow: response (code, payload) already decided."""
 
 
+class _FastHeaders(dict):
+    """Case-insensitive header map for the fast request-parse path
+    (keys stored lower-cased)."""
+
+    def get(self, name, default=None):  # noqa: A003 — dict interface
+        return dict.get(self, name.lower(), default)
+
+    def __contains__(self, name):
+        return dict.__contains__(self, str(name).lower())
+
+
 class FiloHttpServer:
     """Serves one or more datasets; each maps to a list of shards."""
 
@@ -62,7 +73,9 @@ class FiloHttpServer:
                  grpc_peers: Optional[Dict[str, str]] = None,
                  grpc_partitions: Optional[Dict[str, str]] = None,
                  query_timeout_s: float = 30.0,
-                 resilience: Optional[PeerResilience] = None):
+                 resilience: Optional[PeerResilience] = None,
+                 plan_cache_size: int = 256,
+                 max_inflight_queries: int = 4):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -93,11 +106,92 @@ class FiloHttpServer:
         # set by the standalone server: FailureDetector whose down-view
         # rides the health body (quorum input for elastic reassignment)
         self.detector = None
+        # admission control on the QUERY endpoints: with hundreds of
+        # keep-alive connections, unbounded in-flight handlers thrash
+        # the GIL (every runnable thread pays switch-interval
+        # preemptions); excess requests park on a semaphore (futex, no
+        # spin) and are admitted FIFO-ish as slots free. Metadata,
+        # health, and cluster-plane endpoints bypass it.
+        self._query_gate = threading.BoundedSemaphore(
+            max(1, int(max_inflight_queries))) \
+            if max_inflight_queries else None
+        # serving fast path: parsed-plan LRU (start/end abstracted out of
+        # the key; dashboards re-issuing the same text skip parse+plan).
+        # Invalidation: shard-topology events from the mapper, plus the
+        # explicit invalidate_plan_cache() hook for schema changes.
+        from filodb_tpu.query.plancache import PlanCache
+        self.plan_cache = PlanCache(capacity=plan_cache_size)
+        if shard_mapper is not None:
+            try:
+                shard_mapper.subscribe(
+                    lambda ev: self.plan_cache.invalidate("topology"))
+            except Exception:       # mapper without event support
+                pass
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: load clients (and peers' leaf
+            # dispatch) reuse connections instead of paying a TCP
+            # handshake + handler-thread spawn per request; every
+            # response carries Content-Length, so pipelined handling is
+            # safe on the stdlib server
+            protocol_version = "HTTP/1.1"
+            # without TCP_NODELAY the stdlib server's small header
+            # writes hit the Nagle + delayed-ACK interaction: every
+            # response on a persistent connection stalls ~40ms
+            disable_nagle_algorithm = True
+            # buffer the response writes (status line + each header is
+            # its own write() when unbuffered -> one syscall and one
+            # packet per header); flushed per request by handle()
+            wbufsize = 64 * 1024
+
             def log_message(self, fmt, *args):   # quiet
                 pass
+
+            def parse_request(self):
+                """Fast path for plain HTTP/1.0-1.1 requests: the stock
+                parser routes headers through email.parser at ~0.2ms per
+                request — a third of the serving fast path's budget.
+                Anything unusual (odd request line, HTTP/0.9, oversized
+                headers) falls back to the stock parser, which re-reads
+                from ``raw_requestline`` (no header bytes consumed)."""
+                line = str(self.raw_requestline, "iso-8859-1")
+                words = line.rstrip("\r\n").split()
+                if len(words) != 3 or words[2] not in ("HTTP/1.1",
+                                                       "HTTP/1.0"):
+                    return BaseHTTPRequestHandler.parse_request(self)
+                self.requestline = line.rstrip("\r\n")
+                self.command, self.path, self.request_version = words
+                headers = _FastHeaders()
+                prev = None
+                while True:
+                    raw = self.rfile.readline(65537)
+                    if len(raw) > 65536:
+                        self.send_error(431)
+                        return False
+                    if raw in (b"\r\n", b"\n", b""):
+                        break
+                    if raw[:1] in (b" ", b"\t") and prev is not None:
+                        headers[prev] += " " + raw.strip().decode(
+                            "iso-8859-1")
+                        continue
+                    k, _, v = raw.partition(b":")
+                    prev = k.decode("iso-8859-1").strip().lower()
+                    headers[prev] = v.strip().decode("iso-8859-1")
+                self.headers = headers
+                conntype = headers.get("connection", "").lower()
+                if conntype == "close":
+                    self.close_connection = True
+                elif self.request_version == "HTTP/1.1":
+                    self.close_connection = False
+                else:
+                    self.close_connection = conntype != "keep-alive"
+                if headers.get("expect", "").lower() == "100-continue" \
+                        and self.protocol_version >= "HTTP/1.1" \
+                        and self.request_version >= "HTTP/1.1":
+                    if not self.handle_expect_100():
+                        return False
+                return True
 
             def do_GET(self):
                 outer._handle(self)
@@ -105,7 +199,14 @@ class FiloHttpServer:
             def do_POST(self):
                 outer._handle(self)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # stdlib default listen backlog is 5: a burst of concurrent
+            # clients overflows it and every overflowed connect stalls
+            # a full SYN-retransmission timeout (~1s) before the
+            # handshake completes — raise it to serving levels
+            request_queue_size = 128
+
+        self.httpd = _Server((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
@@ -155,7 +256,10 @@ class FiloHttpServer:
         except Exception as e:   # noqa: BLE001 — edge must not crash
             code, payload = 500, prom_json.error(str(e), "internal")
         extra_headers = {}
-        if isinstance(payload, bytes):  # remote-read protobuf
+        if isinstance(payload, prom_json.PreEncoded):
+            body = payload.body
+            ctype = payload.ctype
+        elif isinstance(payload, bytes):  # remote-read protobuf
             body = payload
             ctype = "application/x-protobuf"
             extra_headers["Content-Encoding"] = "snappy"
@@ -238,9 +342,15 @@ class FiloHttpServer:
         if engine is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         if rest == "query_range":
-            return self._query_range(engine, qs)
+            if self._query_gate is None:
+                return self._query_range(engine, qs, ds)
+            with self._query_gate:
+                return self._query_range(engine, qs, ds)
         if rest == "query":
-            return self._query_instant(engine, qs)
+            if self._query_gate is None:
+                return self._query_instant(engine, qs, ds)
+            with self._query_gate:
+                return self._query_instant(engine, qs, ds)
         if rest == "labels":
             return self._labels(engine, qs, ds)
         lm = re.match(r"^label/(?P<name>[^/]+)/values$", rest)
@@ -284,6 +394,14 @@ class FiloHttpServer:
                             grpc_peers=grpc_peers,
                             grpc_partitions=grpc_partitions)
 
+    def invalidate_plan_cache(self, reason: str = "schema") -> None:
+        """Explicit plan-cache invalidation hook. Topology changes flow
+        in automatically via ShardMapper events; callers that change a
+        dataset's SCHEMAS (column set, value column, bucket scheme) must
+        call this so no cached plan outlives the world it was parsed
+        against."""
+        self.plan_cache.invalidate(reason)
+
     # -- endpoints --------------------------------------------------------
     @staticmethod
     def _param(qs, name, default=None):
@@ -307,7 +425,7 @@ class FiloHttpServer:
         except ValueError:
             return default_s
 
-    def _query_range(self, engine, qs):
+    def _query_range(self, engine, qs, ds: str = "timeseries"):
         import time as _time
         query = self._param(qs, "query")
         if not query:
@@ -320,7 +438,14 @@ class FiloHttpServer:
         # query-path spans (the Kamon span surface, QueryActor.scala:113:
         # parse -> materialize -> execute timings ride the response stats)
         t0 = _time.perf_counter()
-        plan = parse_query_range(query, TimeStepParams(start, step, end))
+        plan = self.plan_cache.lookup(ds, query, start * 1000,
+                                      step * 1000, end * 1000)
+        cached = plan is not None
+        if plan is None:
+            plan = parse_query_range(query,
+                                     TimeStepParams(start, step, end))
+            self.plan_cache.store(ds, query, start * 1000, step * 1000,
+                                  end * 1000, plan)
         t1 = _time.perf_counter()
         ex = engine.materialize(plan)
         t2 = _time.perf_counter()
@@ -328,24 +453,44 @@ class FiloHttpServer:
         t3 = _time.perf_counter()
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
-        out = prom_json.matrix(
-            res, hist_wire=bool(self._param(qs, "hist-wire")))
-        out["stats"] = self._query_stats(engine, res)
-        out["stats"]["timings"] = {
+        hist_wire = bool(self._param(qs, "hist-wire"))
+        stats_json = self._query_stats(engine, res)
+        stats_json["timings"] = {
             "parseMs": round((t1 - t0) * 1000, 3),
             "planMs": round((t2 - t1) * 1000, 3),
             "execMs": round((t3 - t2) * 1000, 3),
             "plan": type(ex).__name__,
+            "planCache": "hit" if cached else
+                         ("miss" if self.plan_cache.enabled else "off"),
         }
+        if isinstance(res, GridResult) and not hist_wire \
+                and not res.is_hist():
+            # serving fast path: bulk matrix rows encode straight to
+            # JSON bytes (memoized ts/value fragments), skipping the
+            # dict tree + json.dumps walk
+            st = engine.stats
+            warnings = list(getattr(st, "warnings", ()) or ())
+            warnings.extend(res.warnings)
+            partial = bool(getattr(st, "partial", False) or res.partial)
+            return 200, prom_json.matrix_bytes(
+                res, stats_json, warnings=warnings, partial=partial)
+        out = prom_json.matrix(res, hist_wire=hist_wire)
+        out["stats"] = stats_json
         prom_json.attach_degraded(out, res, engine.stats)
         return 200, out
 
-    def _query_instant(self, engine, qs):
+    def _query_instant(self, engine, qs, ds: str = "timeseries"):
         query = self._param(qs, "query")
         if not query:
             raise QueryError("missing query parameter")
         time_s = int(float(self._param(qs, "time", "0")))
-        plan = parse_query(query, time_s)
+        # instant queries cache under step=0 (start == end == time)
+        plan = self.plan_cache.lookup(ds, query, time_s * 1000, 0,
+                                      time_s * 1000)
+        if plan is None:
+            plan = parse_query(query, time_s)
+            self.plan_cache.store(ds, query, time_s * 1000, 0,
+                                  time_s * 1000, plan)
         res = engine.execute(plan)
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=True)
@@ -481,6 +626,33 @@ class FiloHttpServer:
                  getattr(self.backend, "tile_builds", 0))
             emit("tile_cache_hits_total", {},
                  getattr(self.backend, "tile_hits", 0))
+            # serving fast path: compiled-executable reuse (shape
+            # buckets) + micro-batcher occupancy
+            exec_stats = getattr(self.backend, "executable_cache_stats",
+                                 None)
+            if exec_stats is not None:
+                st = exec_stats()
+                emit("exec_cache_hits_total", {}, st["hits"])
+                emit("exec_cache_misses_total", {}, st["misses"])
+                emit("exec_cache_entries", {}, st["entries"])
+            batcher = getattr(self.backend, "batcher", None)
+            if batcher is not None:
+                bs = batcher.stats.snapshot()
+                emit("batcher_enabled", {}, 1 if batcher.enabled else 0)
+                emit("batcher_batches_total", {}, bs["batches"])
+                emit("batcher_queries_total", {}, bs["queries"])
+                emit("batcher_batched_queries_total", {},
+                     bs["batched_queries"])
+                emit("batcher_occupancy_avg", {}, bs["occupancy_avg"])
+                emit("batcher_occupancy_max", {}, bs["occupancy_max"])
+                emit("batcher_gather_wait_ms_total", {},
+                     bs["gather_wait_ms"])
+        pc = self.plan_cache.snapshot()
+        emit("plan_cache_entries", {}, pc["entries"])
+        emit("plan_cache_hits_total", {}, pc["hits"])
+        emit("plan_cache_misses_total", {}, pc["misses"])
+        emit("plan_cache_rebases_total", {}, pc["rebases"])
+        emit("plan_cache_invalidations_total", {}, pc["invalidations"])
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
@@ -556,22 +728,35 @@ class FiloHttpServer:
         from filodb_tpu.query.model import QueryStats
         if body is None:
             return 400, prom_json.error("missing JSON body")
+        # deadline propagation: the caller (an entry node mid-query)
+        # forwards its REMAINING budget; this leaf inherits it instead
+        # of running unbounded while the entry node has long timed out
+        deadline = None
+        if body.get("timeout_s") is not None:
+            try:
+                deadline = Deadline.after(
+                    min(float(body["timeout_s"]), self.query_timeout_s))
+            except (TypeError, ValueError):
+                deadline = None
         series = self.leaf_select(
             ds, wire_to_filters(body.get("filters", [])),
             int(body["start_ms"]), int(body["end_ms"]),
             body.get("column"), body.get("shards"),
-            span_snap=bool(body.get("full", True)), stats=QueryStats())
+            span_snap=bool(body.get("full", True)), stats=QueryStats(),
+            deadline=deadline)
         if series is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         return 200, {"status": "success", "data": series_to_wire(series)}
 
     def leaf_select(self, ds: str, filters, start_ms: int, end_ms: int,
                     column, want_shards, span_snap: bool = True,
-                    stats=None):
+                    stats=None, deadline: Optional[Deadline] = None):
         """Shared leaf-dispatch selection (HTTP raw endpoint + the gRPC
         FetchRaw service): span-bounded reads with node-scoped snapshot
         keys, so the payload scales with the query span, not retention
-        (SerializedRangeVector semantics, RangeVector.scala:452)."""
+        (SerializedRangeVector semantics, RangeVector.scala:452).
+        ``deadline`` carries the entry node's forwarded remaining
+        budget; selection checks it per shard and fails fast."""
         from filodb_tpu.query.engine import (select_raw_series,
                                              select_span_series)
         shards = self.shards_by_dataset.get(ds)
@@ -585,10 +770,10 @@ class FiloHttpServer:
             return select_span_series(
                 subset, filters, start_ms, end_ms, column, stats,
                 limits=self.query_limits, node_id=self.node_id or "",
-                ds=ds)
+                ds=ds, deadline=deadline)
         return select_raw_series(
             subset, filters, start_ms, end_ms, column, stats,
-            full=False, limits=self.query_limits)
+            full=False, limits=self.query_limits, deadline=deadline)
 
     def _live_peer_urls(self, path_fmt: str, qs: Dict) -> List[str]:
         """URLs for peers whose shards are still queryable (dead peers are

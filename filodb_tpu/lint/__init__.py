@@ -248,7 +248,8 @@ def _load_rule_modules() -> None:
     if _rule_modules_loaded:
         return
     _rule_modules_loaded = True
-    from filodb_tpu.lint import rules_kernel, rules_lock, rules_trace  # noqa: F401
+    from filodb_tpu.lint import (rules_hot, rules_kernel,  # noqa: F401
+                                 rules_lock, rules_trace)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
@@ -262,7 +263,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     verified (VMEM budget, tiling, grid bounds, span guard,
     ``jax.eval_shape``)."""
     _load_rule_modules()
-    from filodb_tpu.lint import rules_kernel, rules_lock, rules_trace
+    from filodb_tpu.lint import (rules_hot, rules_kernel, rules_lock,
+                                 rules_trace)
     root = package_root()
     if paths is None:
         paths = [os.path.join(root, "filodb_tpu")]
@@ -286,6 +288,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         for f in rules_kernel.check_module(mod):
             raw.append((mod, f))
         for f in rules_trace.check_module(mod):
+            raw.append((mod, f))
+        for f in rules_hot.check_module(mod):
             raw.append((mod, f))
         for f in rules_lock.check_module(mod, lock_decls):
             raw.append((mod, f))

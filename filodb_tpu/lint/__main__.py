@@ -23,6 +23,9 @@ def main(argv=None) -> int:
                          "filodb_tpu package)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit machine-readable findings on stdout")
+    ap.add_argument("--github", action="store_true", dest="as_github",
+                    help="emit GitHub workflow ::error/::warning "
+                         "annotation lines (CI inline PR comments)")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the shipped "
                          "filodb_tpu/lint/baseline.json)")
@@ -41,7 +44,13 @@ def main(argv=None) -> int:
     result = run_lint(args.paths or None,
                       baseline=load_baseline(args.baseline),
                       check_contracts=not args.no_contracts)
-    if args.as_json:
+    if args.as_github:
+        from filodb_tpu.lint.ci_annotations import github_annotations
+        for line in github_annotations(result.to_json()):
+            print(line)
+        print(f"graftlint: {result.files} file(s), "
+              f"{len(result.errors)} error(s)", file=sys.stderr)
+    elif args.as_json:
         print(json.dumps(result.to_json(), indent=2, sort_keys=True))
     else:
         for f in result.findings:

@@ -1,0 +1,52 @@
+"""GitHub-workflow annotations from graftlint findings.
+
+Turns a :class:`~filodb_tpu.lint.LintResult` (or its ``--json``
+serialization) into GitHub's workflow-command lines::
+
+    ::error file=filodb_tpu/query/tpu.py,line=512,title=graftlint trace-side-effect::print() inside a traced function
+
+printed on stdout so a CI step like
+
+.. code-block:: yaml
+
+    - run: python -m filodb_tpu.lint --github
+
+surfaces findings as inline PR annotations. New findings annotate as
+``error``; baselined (grandfathered) findings annotate as ``warning``
+so they stay visible without failing the run. Messages are sanitized
+per the workflow-command escaping rules (%, CR, LF in the message;
+additionally ``,`` and ``:`` in properties).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def _esc_msg(s: str) -> str:
+    return (str(s).replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def _esc_prop(s: str) -> str:
+    return (_esc_msg(s).replace(":", "%3A").replace(",", "%2C"))
+
+
+def _line(level: str, f: Dict) -> str:
+    return (f"::{level} file={_esc_prop(f.get('path', ''))},"
+            f"line={int(f.get('line', 1))},"
+            f"title={_esc_prop('graftlint ' + f.get('rule', ''))}"
+            f"::{_esc_msg(f.get('message', ''))}")
+
+
+def github_annotations(result_json: Dict) -> List[str]:
+    """Workflow-command lines for one lint run (``LintResult.to_json()``
+    shape): errors for new findings, warnings for baselined ones."""
+    out: List[str] = []
+    for f in result_json.get("findings", []):
+        level = "error" if f.get("severity", "error") == "error" \
+            else "warning"
+        out.append(_line(level, f))
+    for f in result_json.get("baselined", []):
+        out.append(_line("warning", f))
+    return out

@@ -170,14 +170,21 @@ class RemoteShardGroup:
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],
                   full: bool = True) -> List[RawSeries]:
-        body = json.dumps({
+        msg = {
             "filters": filters_to_wire(filters),
             "start_ms": int(start_ms), "end_ms": int(end_ms),
             "column": column, "shards": self.shard_nums,
             "full": bool(full),
-        }).encode()
+        }
 
         def dial(timeout_s: float) -> Dict:
+            # server-side deadline propagation: the peer inherits the
+            # entry node's REMAINING budget (re-read per attempt — a
+            # retry must not hand the peer the original full budget)
+            if self.deadline is not None:
+                msg["timeout_s"] = round(
+                    max(self.deadline.remaining(), 1e-3), 3)
+            body = json.dumps(msg).encode()
             req = urllib.request.Request(
                 f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,
                 headers={"Content-Type": "application/json"})
@@ -247,11 +254,20 @@ class PromQlRemoteExec:
         if self.local_only:
             qs["dispatch"] = "local"    # no fan-back-out (loop prevention)
         qs["hist-wire"] = "1"
-        url = (f"{self.base_url}/promql/{self.dataset}/api/v1/{path}?"
-               + urllib.parse.urlencode(qs))
+
+        def dial(t: float) -> Dict:
+            # forward the remaining deadline budget so the peer's own
+            # evaluation inherits it (&timeout=, the knob the HTTP edge
+            # already parses); re-read per attempt
+            if self.deadline is not None:
+                qs["timeout"] = "%.3fs" % max(self.deadline.remaining(),
+                                              1e-3)
+            url = (f"{self.base_url}/promql/{self.dataset}/api/v1/"
+                   f"{path}?" + urllib.parse.urlencode(qs))
+            return _get_json(url, self.node_id, t)
+
         payload = resilient_call(
-            lambda t: _get_json(url, self.node_id, t),
-            key=self.base_url, node_id=self.node_id,
+            dial, key=self.base_url, node_id=self.node_id,
             timeout_s=self.timeout_s, retry=self.retry,
             breakers=self.breakers, deadline=self.deadline)
         if self.stats is not None and "stats" in payload:

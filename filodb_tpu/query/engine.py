@@ -47,8 +47,8 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
                       column: Optional[str] = None,
                       stats: Optional[QueryStats] = None,
                       full: bool = False,
-                      limits: Optional[QueryLimits] = None
-                      ) -> List[RawSeries]:
+                      limits: Optional[QueryLimits] = None,
+                      deadline=None) -> List[RawSeries]:
     """Gather raw samples for all matching series across shards
     (SelectRawPartitionsExec.scala:159 doExecute; schema resolved per
     partition like MultiSchemaPartitionsExec).
@@ -59,6 +59,8 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     itself restricts the evaluation to the query range."""
     out: List[RawSeries] = []
     for shard in shards:
+        if deadline is not None:
+            deadline.check("raw series selection")
         fetch_raw = getattr(shard, "fetch_raw", None)
         if fetch_raw is not None:       # RemoteShardGroup: peer dispatch
             try:
@@ -144,7 +146,8 @@ def select_span_series(shards: Sequence[TimeSeriesShard],
                        column: Optional[str] = None,
                        stats: Optional[QueryStats] = None,
                        limits: Optional[QueryLimits] = None,
-                       node_id: str = "", ds: str = "") -> List[RawSeries]:
+                       node_id: str = "", ds: str = "",
+                       deadline=None) -> List[RawSeries]:
     """Leaf-dispatch selection: SPAN-BOUNDED reads with node-scoped
     snapshot keys — the SerializedRangeVector analogue
     (core/query/RangeVector.scala:452). The wire payload scales with the
@@ -156,6 +159,8 @@ def select_span_series(shards: Sequence[TimeSeriesShard],
     write-buffer tail rows are spliced live."""
     out: List[RawSeries] = []
     for shard in shards:
+        if deadline is not None:
+            deadline.check("span series selection")
         for part in shard.lookup_partitions(filters, start_ms, end_ms):
             schema = part.schema
             col_name = column or schema.value_column

@@ -1039,6 +1039,38 @@ def _f32_epilogue(func, counts, t1, v1, t2, v2, wstart_r, wend_r, wdur_s):
 
 _EVAL_T_JIT: Dict[Tuple, object] = {}
 
+# executable-reuse observability: every dispatch-table lookup counts a
+# hit (compiled program reused) or a miss (new trace+compile). Shared
+# by the scalar and vmapped (micro-batched) dispatch families and
+# surfaced in /metrics as the executable-cache counters.
+import threading as _threading
+
+_JIT_STATS = {"hits": 0, "misses": 0}
+_JIT_STATS_LOCK = _threading.Lock()
+__guarded_by__ = {"_JIT_STATS": "_JIT_STATS_LOCK"}
+
+
+def _jit_lookup(cache: Dict[Tuple, object], key: Tuple, build) -> object:
+    """Dispatch-table lookup with hit/miss accounting; ``build()`` makes
+    the jitted callable on a miss."""
+    fn = cache.get(key)
+    with _JIT_STATS_LOCK:
+        _JIT_STATS["hits" if fn is not None else "misses"] += 1
+    if fn is None:
+        fn = build()
+        cache[key] = fn
+    return fn
+
+
+def executable_cache_stats() -> Dict[str, int]:
+    """Snapshot of compiled-executable reuse across the tilestore
+    dispatch tables (scalar + vmapped families)."""
+    with _JIT_STATS_LOCK:
+        out = dict(_JIT_STATS)
+    out["entries"] = (len(_EVAL_JIT) + len(_EVAL_T_JIT)
+                      + len(_EVAL_T_VMAP) + len(_EVAL_VMAP))
+    return out
+
 
 def _slide_eligible(tiles: AlignedTiles, nsteps: int, w0s: int, w0e: int,
                     last_ms: int, step: int):
@@ -1094,34 +1126,24 @@ def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
         st, _, _ = el
         arrs = _tiles_arrays_slide(tiles, func, st)
         key = ("slide", func, nsteps, st)
-        fn = _EVAL_T_JIT.get(key)
-        if fn is None:
-            fn = jax.jit(_functools.partial(_eval_counter_slide, func,
-                                            nsteps, st))
-            _EVAL_T_JIT[key] = fn
-        return fn(arrs, jnp.asarray(np.int64(tiles.num_slots)),
-                  jnp.asarray(np.int64(tiles.base_ms)),
-                  jnp.asarray(np.int64(tiles.dt_ms)),
-                  jnp.asarray(w0s), jnp.asarray(w0e), jnp.asarray(step))
+        fn = _jit_lookup(_EVAL_T_JIT, key, lambda: jax.jit(
+            _functools.partial(_eval_counter_slide, func, nsteps, st)))
+        return fn(arrs, np.int64(tiles.num_slots),
+                  np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+                  np.int64(w0s), np.int64(w0e), np.int64(step))
     if fits_i32:
         arrs = _tiles_arrays_fast(tiles, func)
-        key = ("fast", func, nsteps)
-        fn = _EVAL_T_JIT.get(key)
-        if fn is None:
-            fn = jax.jit(_functools.partial(_eval_counter_fast, func,
-                                            nsteps))
-            _EVAL_T_JIT[key] = fn
+        fn = _jit_lookup(_EVAL_T_JIT, ("fast", func, nsteps),
+                         lambda: jax.jit(_functools.partial(
+                             _eval_counter_fast, func, nsteps)))
     else:
         arrs = _tiles_arrays_t(tiles, func)
-        key = ("t", func, nsteps)
-        fn = _EVAL_T_JIT.get(key)
-        if fn is None:
-            fn = jax.jit(_functools.partial(_eval_counter_t, func, nsteps))
-            _EVAL_T_JIT[key] = fn
-    return fn(arrs, jnp.asarray(np.int64(tiles.num_slots)),
-              jnp.asarray(np.int64(tiles.base_ms)),
-              jnp.asarray(np.int64(tiles.dt_ms)),
-              jnp.asarray(w0s), jnp.asarray(w0e), jnp.asarray(step))
+        fn = _jit_lookup(_EVAL_T_JIT, ("t", func, nsteps),
+                         lambda: jax.jit(_functools.partial(
+                             _eval_counter_t, func, nsteps)))
+    return fn(arrs, np.int64(tiles.num_slots),
+              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+              np.int64(w0s), np.int64(w0e), np.int64(step))
 
 
 @kernel_contract(
@@ -1238,12 +1260,119 @@ def evaluate_aligned(tiles: AlignedTiles, func: str, steps: np.ndarray,
     w0s = np.int64(w0e - window_ms)
     step = np.int64(steps[1] - steps[0]) if nsteps > 1 else np.int64(1)
     arrs = _tiles_arrays(tiles, func)
-    key = (func, nsteps)
-    fn = _EVAL_JIT.get(key)
-    if fn is None:
-        fn = jax.jit(_functools.partial(_eval_core, func, nsteps))
-        _EVAL_JIT[key] = fn
-    return fn(arrs, jnp.asarray(np.int64(tiles.num_slots)),
-              jnp.asarray(np.int64(tiles.base_ms)),
-              jnp.asarray(np.int64(tiles.dt_ms)),
-              jnp.asarray(w0s), jnp.asarray(w0e), jnp.asarray(step))
+    fn = _jit_lookup(_EVAL_JIT, (func, nsteps), lambda: jax.jit(
+        _functools.partial(_eval_core, func, nsteps)))
+    return fn(arrs, np.int64(tiles.num_slots),
+              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+              np.int64(w0s), np.int64(w0e), np.int64(step))
+
+
+# ---------------------------------------------------------------------------
+# Micro-batched (multi-grid) dispatch: vmapped evaluator families
+# ---------------------------------------------------------------------------
+#
+# The query micro-batcher (query/batcher.py) stacks concurrent queries
+# that share (tiles, func, nsteps, step, window) but differ in grid
+# position (w0s/w0e) — the dashboard-refresh / concurrent-client shape.
+# Each family below is the SAME traceable body as its scalar dispatch,
+# vmapped over the (w0s, w0e) scalars only, so member i of a batch is
+# bit-for-bit the scalar path's output (pinned by test_batcher's parity
+# tests): the batch axis adds a leading dim, every op stays row-local.
+
+_EVAL_T_VMAP: Dict[Tuple, object] = {}
+_EVAL_VMAP: Dict[Tuple, object] = {}
+
+_GRID_AXES = (None, None, None, None, 0, 0, None)
+
+
+def _pad_pow2(vals: Sequence[int]) -> np.ndarray:
+    """Pad a member-scalar list to a coarse batch-width bucket (2, 8,
+    32, 128, ...) by repeating the last member. Coarse x4 buckets keep
+    the number of compiled batch widths tiny — an XLA compile costs
+    ~100ms while computing a few redundant pad grids costs microseconds,
+    so trading pad work for compile-cache hits is the right side of the
+    bargain on the serving path."""
+    b = 2
+    while b < len(vals):
+        b <<= 2
+    out = list(vals) + [vals[-1]] * (b - len(vals))
+    return np.asarray(out, np.int64)
+
+
+def counters_batch_family(tiles: AlignedTiles, func: str,
+                          steps: np.ndarray, window_ms: int,
+                          offset_ms: int = 0) -> Optional[Tuple]:
+    """Hashable dispatch-family key for one counter query — two queries
+    may share a batched dispatch only when their families match (the
+    family fixes which compiled evaluator the scalar path would pick,
+    so batching never changes the kernel choice)."""
+    nsteps = steps.size
+    w0e = int(steps[0] - offset_ms)
+    w0s = w0e - window_ms
+    step = int(steps[1] - steps[0]) if nsteps > 1 else 1
+    el = _slide_eligible(tiles, nsteps, w0s, w0e,
+                         int(steps[-1] - offset_ms), step)
+    if el is not None:
+        return ("slide", el[0])
+    lo_rel = w0s - tiles.base_ms
+    hi_rel = int(steps[-1] - offset_ms) - tiles.base_ms
+    fits_i32 = (_SENT_LO < lo_rel and hi_rel < _SENT_HI
+                and tiles.num_slots * tiles.dt_ms + tiles.dt_ms < _SENT_HI)
+    return ("fast",) if fits_i32 else ("t",)
+
+
+def evaluate_counters_t_batch(tiles: AlignedTiles, func: str,
+                              family: Tuple, nsteps: int, step: int,
+                              w0s_list: Sequence[int],
+                              w0e_list: Sequence[int]) -> jnp.ndarray:
+    """One vmapped dispatch computing B counter grids over shared tiles
+    -> device [B_pad, T, S] (callers slice [:len(w0s_list)]). All
+    members must share ``family`` (see counters_batch_family)."""
+    assert func in ("rate", "increase", "delta")
+    w0s_v = jnp.asarray(_pad_pow2(list(w0s_list)))
+    w0e_v = jnp.asarray(_pad_pow2(list(w0e_list)))
+    b_pad = int(w0s_v.shape[0])
+    kind = family[0]
+    if kind == "slide":
+        st = family[1]
+        arrs = _tiles_arrays_slide(tiles, func, st)
+        fn = _jit_lookup(_EVAL_T_VMAP, ("slide", func, nsteps, st, b_pad),
+                         lambda: jax.jit(jax.vmap(
+                             _functools.partial(_eval_counter_slide, func,
+                                                nsteps, st),
+                             in_axes=_GRID_AXES)))
+    elif kind == "fast":
+        arrs = _tiles_arrays_fast(tiles, func)
+        fn = _jit_lookup(_EVAL_T_VMAP, ("fast", func, nsteps, b_pad),
+                         lambda: jax.jit(jax.vmap(
+                             _functools.partial(_eval_counter_fast, func,
+                                                nsteps),
+                             in_axes=_GRID_AXES)))
+    else:
+        arrs = _tiles_arrays_t(tiles, func)
+        fn = _jit_lookup(_EVAL_T_VMAP, ("t", func, nsteps, b_pad),
+                         lambda: jax.jit(jax.vmap(
+                             _functools.partial(_eval_counter_t, func,
+                                                nsteps),
+                             in_axes=_GRID_AXES)))
+    return fn(arrs, np.int64(tiles.num_slots),
+              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+              w0s_v, w0e_v, np.int64(step))
+
+
+def evaluate_aligned_batch(tiles: AlignedTiles, func: str, nsteps: int,
+                           step: int, w0s_list: Sequence[int],
+                           w0e_list: Sequence[int]) -> jnp.ndarray:
+    """One vmapped dispatch computing B aligned grids (non-counter
+    families) over shared tiles -> device [B_pad, S, T]."""
+    w0s_v = jnp.asarray(_pad_pow2(list(w0s_list)))
+    w0e_v = jnp.asarray(_pad_pow2(list(w0e_list)))
+    b_pad = int(w0s_v.shape[0])
+    arrs = _tiles_arrays(tiles, func)
+    fn = _jit_lookup(_EVAL_VMAP, (func, nsteps, b_pad),
+                     lambda: jax.jit(jax.vmap(
+                         _functools.partial(_eval_core, func, nsteps),
+                         in_axes=_GRID_AXES)))
+    return fn(arrs, np.int64(tiles.num_slots),
+              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+              w0s_v, w0e_v, np.int64(step))

@@ -42,6 +42,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.contracts import kernel_contract
+from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
 
 
@@ -129,14 +130,39 @@ def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
     return ts_pad, vals_pad, lens
 
 
+def _pad_series_rows(ts: np.ndarray, vals: np.ndarray, lens: np.ndarray,
+                     s_bucket: int):
+    """Pad the series axis to a pow2 bucket (executable reuse): pad rows
+    are all-sentinel/empty, produce all-NaN outputs, and are sliced off
+    by the caller."""
+    S, N = ts.shape
+    ts2 = np.full((s_bucket, N), _TS_PAD, dtype=np.int64)
+    vals2 = np.zeros((s_bucket, N), dtype=np.float64)
+    lens2 = np.zeros(s_bucket, dtype=np.int32)
+    ts2[:S] = ts
+    vals2[:S] = vals
+    lens2[:S] = lens
+    return ts2, vals2, lens2
+
+
 # ---------------------------------------------------------------------------
 # Device kernels
 # ---------------------------------------------------------------------------
 
+def _colify(x):
+    """Grid scalars may arrive per-row ([S] vectors) when the
+    micro-batcher stacks queries with different windows along the
+    series axis; reshape to a broadcastable [S, 1] column (scalars
+    pass through — rank is static under trace)."""
+    return x[:, None] if getattr(x, "ndim", 0) == 1 else x
+
+
 def _grid(w0s, w0e, step, nsteps):
-    """Reconstruct the uniform window grid on device from scalars."""
+    """Reconstruct the uniform window grid on device: [T] for scalar
+    inputs, [S, T] for per-row ([S]) inputs (micro-batched stacking)."""
     t = jnp.arange(nsteps, dtype=jnp.int64)
-    return w0s + t * step, w0e + t * step
+    return _colify(w0s) + t * _colify(step), \
+        _colify(w0e) + t * _colify(step)
 
 
 def _bounds(ts, w0s, w0e, step, nsteps):
@@ -155,7 +181,9 @@ def _bounds(ts, w0s, w0e, step, nsteps):
     into the counts above. Pad samples (ts=_TS_PAD) land in the dropped
     overflow bucket."""
     S, N = ts.shape
-    step = jnp.maximum(step, 1)
+    step = jnp.maximum(_colify(step), 1)
+    w0s = _colify(w0s)
+    w0e = _colify(w0e)
     rows = jnp.arange(S)[:, None]
     b_lo = jnp.clip((ts - w0s) // step + 1, 0, nsteps).astype(jnp.int32)
     b_hi = jnp.clip(-((w0e - ts) // step), 0, nsteps).astype(jnp.int32)
@@ -227,9 +255,15 @@ def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
     """Endpoint + prefix-sum family, one fused kernel.
 
     The window grid is uniform: wstart[t] = w0s + t*step,
-    wend[t] = w0e + t*step (scalars traced, nsteps static)."""
+    wend[t] = w0e + t*step (scalars traced, nsteps static). Grid args
+    may instead be [S] vectors — per-ROW grids, used by the
+    micro-batcher to stack queries with different windows along the
+    series axis; every op below is row-local, so a stacked row's output
+    is bit-for-bit the single-query output."""
     S, N = ts.shape
     wstart, wend = _grid(w0s, w0e, step, nsteps)
+    ws2 = wstart if wstart.ndim == 2 else wstart[None, :]
+    we2 = wend if wend.ndim == 2 else wend[None, :]
     lo, hi = _bounds(ts, w0s, w0e, step, nsteps)
     counts = hi - lo + 1
     has = counts >= 1
@@ -240,7 +274,7 @@ def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
     if func in _ENDPOINT_RATE:
         counter, is_rate = _ENDPOINT_RATE[func]
         v = vals + _correction(vals, lens) if counter else vals
-        out = _extrapolated_rate(wstart[None, :], wend[None, :], counts,
+        out = _extrapolated_rate(ws2, we2, counts,
                                  _take(ts, lo_c), _take(v, lo_c),
                                  _take(ts, hi_c), _take(v, hi_c),
                                  counter, is_rate)
@@ -293,7 +327,7 @@ def _window_endpoint(func: str, ts, vals, lens, w0s, w0e,
     if func in ("sum_over_time", "increase_over_delta"):
         out = s
     elif func == "rate_over_delta":
-        out = s / (wend - wstart)[None, :] * 1000.0
+        out = s / (we2 - ws2) * 1000.0
     elif func == "count_over_time":
         out = cnt
     elif func == "avg_over_time":
@@ -425,6 +459,8 @@ def _window_endpoint_pallas(func, ts, vals, lens, w0s, w0e, step, nsteps):
     if not span_ok:
         return None
     on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu and not PALLAS_RATE_INTERPRET:
+        return None     # CPU serving: endpoint kernel (see flag above)
     if not on_tpu and ts.size > 262_144:
         return None     # interpret mode is for small (test) shapes only
     return _pallas_rate_impl(func, nsteps, not on_tpu,
@@ -437,20 +473,106 @@ def _window_endpoint_pallas(func, ts, vals, lens, w0s, w0e, step, nsteps):
 # mode on the CPU test mesh; production CPU nodes leave it off
 FUSED_GROUPSUM_INTERPRET = False
 
+# tests set this to exercise the Pallas boundary-extract rate path in
+# interpret mode on CPU; production CPU nodes leave it off — interpret
+# mode re-jits per (shape, nsteps) at ~0.5-1s a piece, and with live
+# ingest moving the write-buffer tail every flush changes the tail
+# step count, so a serving node would hit a fresh compile every few
+# seconds. The endpoint kernel is bit-for-bit identical for the rate
+# family (pinned by test_batcher), so CPU serving loses nothing.
+PALLAS_RATE_INTERPRET = False
+
+
+class _TileEntry:
+    """One tile-cache entry: device tiles over an immutable prefix,
+    plus the coverage bound that makes stale serves correct."""
+
+    __slots__ = ("tiles", "idx", "prefix_has_nan", "refs", "cov_min_ms",
+                 "ident_key")
+
+    def __init__(self, tiles, idx, prefix_has_nan, refs, cov_min_ms,
+                 ident_key=None):
+        self.tiles = tiles
+        self.idx = idx
+        self.prefix_has_nan = prefix_has_nan
+        self.refs = refs
+        self.cov_min_ms = cov_min_ms    # first ms NOT in tiles; None=all
+        self.ident_key = ident_key
+
+
+class _PackedMember:
+    """One query's packed tile + grid scalars inside a packed batch."""
+
+    __slots__ = ("ts", "vals", "lens", "w0s", "w0e", "step", "nsteps",
+                 "w_bound")
+
+    def __init__(self, ts, vals, lens, w0s, w0e, step, nsteps, w_bound):
+        self.ts = ts
+        self.vals = vals
+        self.lens = lens
+        self.w0s = w0s
+        self.w0e = w0e
+        self.step = step
+        self.nsteps = nsteps
+        self.w_bound = w_bound
+
 
 class TpuBackend:
     """Pluggable device backend for QueryEngine (the ``--exec-backend=tpu``
-    boundary from BASELINE.json)."""
+    boundary from BASELINE.json).
 
-    def __init__(self, device: Optional[object] = None):
+    ``batcher`` (query/batcher.py MicroBatcher, on by default) is the
+    serving fast path's admission layer: concurrent queries resolving to
+    the same bucketed kernel shape share one device dispatch — along the
+    grid axis for the aligned tilestore evaluators, along the series
+    axis (with per-query segment offsets) for the general packed path.
+    Pass ``batcher=None``/``MicroBatcher(enabled=False)`` to always take
+    the single-query kernel paths."""
+
+    def __init__(self, device: Optional[object] = None,
+                 batcher: Optional[object] = "default"):
         self.device = device
         self._tile_cache: Dict = {}
         # guards cache get/insert/evict against concurrent HTTP query
         # threads (non-atomic FIFO evict could KeyError, inserts overshoot)
         self._tile_lock = threading.Lock()
+        # selection identity (snapshot keys minus chunk counts) -> the
+        # latest cache key: lets a post-flush query serve the previous
+        # snapshot's tiles while the rebuild runs in the background
+        self._tile_ident: Dict = {}
+        self._tile_refreshing: set = set()
         self.tile_builds = 0    # observability: device tile (re)builds
         self.tile_hits = 0      # observability: cache hits
         self.fused_aggs = 0     # observability: fused group-sum queries
+        if batcher == "default":
+            from filodb_tpu.query.batcher import MicroBatcher
+            batcher = MicroBatcher()
+        self.batcher = batcher
+        # executable-reuse observability for the packed kernel family:
+        # a (kernel, func, S/N/T-bucket) combination seen before means
+        # the jit cache serves it without a retrace
+        self._exec_lock = threading.Lock()
+        self._exec_keys: set = set()
+        self.exec_cache_hits = 0
+        self.exec_cache_misses = 0
+
+    def _count_exec(self, key: Tuple) -> None:
+        with self._exec_lock:
+            if key in self._exec_keys:
+                self.exec_cache_hits += 1
+            else:
+                self._exec_keys.add(key)
+                self.exec_cache_misses += 1
+
+    def executable_cache_stats(self) -> Dict[str, int]:
+        """Packed-kernel + tilestore executable-reuse counters (the
+        compile-cache hit/miss surface in /metrics)."""
+        from filodb_tpu.query import tilestore as tst
+        ts_stats = tst.executable_cache_stats()
+        with self._exec_lock:
+            return {"hits": self.exec_cache_hits + ts_stats["hits"],
+                    "misses": self.exec_cache_misses + ts_stats["misses"],
+                    "entries": len(self._exec_keys) + ts_stats["entries"]}
 
     def periodic_samples(self, series: Sequence[RawSeries],
                          params: RangeParams, function: str, window_ms: int,
@@ -469,19 +591,27 @@ class TpuBackend:
         if nsteps == 0:
             return GridResult(steps, keys,
                               np.empty((len(series), 0), dtype=np.float64))
-        aligned = self._try_aligned(series, func, steps, params.step_ms,
-                                    window_ms, offset_ms, func_args)
-        if aligned is not None:
-            return GridResult(steps, keys, aligned)
-        out = self._general(series, func, steps, params.step_ms, window_ms,
-                            offset_ms, func_args)
+        if self.batcher is not None:
+            self.batcher.enter()
+        try:
+            aligned = self._try_aligned(series, func, steps, params.step_ms,
+                                        window_ms, offset_ms, func_args)
+            if aligned is not None:
+                return GridResult(steps, keys, aligned)
+            out = self._general(series, func, steps, params.step_ms,
+                                window_ms, offset_ms, func_args)
+        finally:
+            if self.batcher is not None:
+                self.batcher.exit()
         return GridResult(steps, keys, out)
 
     def _general(self, series, func: str, steps: np.ndarray, step_ms: int,
                  window_ms: int, offset_ms: int, func_args) -> np.ndarray:
         """General packed path (any cadence): fused window kernels over
         padded [S, N] tiles. ``steps`` may be any contiguous slice of a
-        uniform grid."""
+        uniform grid. Host-side packing happens here, on the calling
+        worker thread — under the micro-batcher it overlaps device
+        compute of the previous batch."""
         from filodb_tpu.query.engine import clip_series
 
         nsteps = steps.size
@@ -494,25 +624,156 @@ class TpuBackend:
                              int(steps[-1] - offset_ms))
         ts, vals, lens = pack_series(series, drop_nan=(func != "last_sample"))
         scalar = float(func_args[0]) if func_args else 0.0
+        w_bound = self._window_sample_bound(series, window_ms, ts.shape[1]) \
+            if func in _GATHER_FUNCS else 0
+        t_bucket = _next_pow2(nsteps, 8)
+        b = self.batcher
+        if b is not None and b.enabled:
+            # concurrent queries sharing (func, N, T-bucket) stack along
+            # the series axis and run as ONE kernel dispatch
+            key = ("packed", func, ts.shape[1], t_bucket,
+                   func != "last_sample", scalar)
+            member = _PackedMember(ts, vals, lens, int(w0s), int(w0e),
+                                   int(step), nsteps, w_bound)
+            return b.submit(key, member, functools.partial(
+                self._packed_run, func, t_bucket, scalar))
+        return self._packed_single(func, ts, vals, lens, w0s, w0e, step,
+                                   nsteps, t_bucket, scalar, w_bound)
+
+    @hot_path
+    def _packed_single(self, func, ts, vals, lens, w0s, w0e, step, nsteps,
+                       t_bucket, scalar, w_bound) -> np.ndarray:
+        """Single-query packed dispatch with pow2 shape bucketing: S and
+        the step count pad to buckets so repeat queries of nearby shapes
+        reuse compiled executables instead of retracing."""
+        S, N = ts.shape
+        s_bucket = _next_pow2(S, 8)
+        if s_bucket != S:
+            ts, vals, lens = _pad_series_rows(ts, vals, lens, s_bucket)
         if func in _GATHER_FUNCS:
-            w_bound = self._window_sample_bound(series, window_ms, ts.shape[1])
+            self._count_exec(("gather", func, s_bucket, N, t_bucket,
+                              w_bound))
             out = _window_gather(func, w_bound, ts, vals, lens,
-                                 w0s, w0e, step, nsteps, scalar)
+                                 w0s, w0e, step, t_bucket, scalar)
         else:
-            out = None
             if func in _PALLAS_FUNCS:
+                # the Pallas boundary-extract path keeps the exact step
+                # count (its grid layout is nsteps-derived); bit-for-bit
+                # with _window_endpoint — pinned by test_batcher
                 out = _window_endpoint_pallas(func, ts, vals, lens, w0s,
                                               w0e, step, nsteps)
-            if out is None:
-                out = _window_endpoint(func, ts, vals, lens,
-                                       w0s, w0e, step, nsteps, scalar)
-        return np.asarray(out)
+                if out is not None:
+                    self._count_exec(("pallas", func, s_bucket, N, nsteps))
+                    # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+                    return np.asarray(out)[:S]
+            self._count_exec(("endpoint", func, s_bucket, N, t_bucket))
+            out = _window_endpoint(func, ts, vals, lens,
+                                   w0s, w0e, step, t_bucket, scalar)
+        # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+        return np.asarray(out)[:S, :nsteps]
+
+    def _packed_run(self, func: str, t_bucket: int, scalar: float,
+                    members) -> object:
+        """Execute one packed batch: stack member tiles along the series
+        axis, dispatch ONE kernel with per-row window vectors, split by
+        per-query segment offsets. A batch of one takes the single-query
+        path (bit-for-bit identical; the parity test pins it)."""
+        from filodb_tpu.query.batcher import SplitResult
+
+        if len(members) == 1:
+            m = members[0]
+            out = self._packed_single(func, m.ts, m.vals, m.lens,
+                                      np.int64(m.w0s), np.int64(m.w0e),
+                                      np.int64(m.step), m.nsteps, t_bucket,
+                                      scalar, m.w_bound)
+            return SplitResult(out, 1, split=lambda h, i: h)
+        offs = np.cumsum([0] + [m.ts.shape[0] for m in members])
+        s_total = int(offs[-1])
+        s_bucket = _next_pow2(s_total, 8)
+        N = members[0].ts.shape[1]
+        ts = np.full((s_bucket, N), _TS_PAD, dtype=np.int64)
+        vals = np.zeros((s_bucket, N), dtype=np.float64)
+        lens = np.zeros(s_bucket, dtype=np.int32)
+        w0s_v = np.zeros(s_bucket, dtype=np.int64)
+        w0e_v = np.ones(s_bucket, dtype=np.int64)
+        step_v = np.ones(s_bucket, dtype=np.int64)
+        for m, o in zip(members, offs):
+            sl = slice(int(o), int(o) + m.ts.shape[0])
+            ts[sl] = m.ts
+            vals[sl] = m.vals
+            lens[sl] = m.lens
+            w0s_v[sl] = m.w0s
+            w0e_v[sl] = m.w0e
+            step_v[sl] = m.step
+        if func in _GATHER_FUNCS:
+            w_bound = max(m.w_bound for m in members)
+            self._count_exec(("gather-b", func, s_bucket, N, t_bucket,
+                              w_bound))
+            dev = _window_gather(func, w_bound, ts, vals, lens,
+                                 jnp.asarray(w0s_v), jnp.asarray(w0e_v),
+                                 jnp.asarray(step_v), t_bucket, scalar)
+        else:
+            # rate-family members ride _window_endpoint here (the Pallas
+            # boundary-extract kernel takes scalar grids); exact f64 on
+            # both paths — bit-for-bit, pinned by the parity test
+            self._count_exec(("endpoint-b", func, s_bucket, N, t_bucket))
+            dev = _window_endpoint(func, ts, vals, lens,
+                                   jnp.asarray(w0s_v), jnp.asarray(w0e_v),
+                                   jnp.asarray(step_v), t_bucket, scalar)
+        sizes = [m.ts.shape[0] for m in members]
+        nst = [m.nsteps for m in members]
+
+        def split(host: np.ndarray, i: int) -> np.ndarray:
+            o = int(offs[i])
+            return host[o:o + sizes[i], :nst[i]]
+
+        return SplitResult(dev, len(members), split=split)
 
     _TILE_CACHE_MAX = 16
 
     @staticmethod
     def _prefix_len(s) -> int:
         return s.chunk_len if s.chunk_len >= 0 else s.ts.size
+
+    def _build_tile_entry(self, series, use_snap: bool):
+        """Build one tile-cache entry over the series' immutable chunk
+        prefixes. ``cov_min_ms`` records the first timestamp NOT covered
+        by the tiles (None = full coverage): consumers must route steps
+        whose windows reach past it through the packed path — this is
+        what makes serving a STALE entry correct while a flush's rebuild
+        runs in the background."""
+        from filodb_tpu.query import tilestore as tst
+
+        prefix = [
+            RawSeries(s.labels, s.ts[:self._prefix_len(s)],
+                      s.values[:self._prefix_len(s)], s.is_counter,
+                      s.bucket_les)
+            for s in series
+        ]
+        cov_min = None
+        for s in series:
+            cl = self._prefix_len(s)
+            if cl < s.ts.size:
+                tm = int(s.ts[cl])
+                cov_min = tm if cov_min is None else min(cov_min, tm)
+        tiles, idx = tst.build_aligned_tiles(prefix)
+        self.tile_builds += 1
+        prefix_has_nan = any(np.isnan(p.values).any() for p in prefix)
+        return _TileEntry(tiles, idx, prefix_has_nan,
+                          None if use_snap else list(series), cov_min)
+
+    def _insert_tile_entry(self, key, ident, entry) -> None:
+        with self._tile_lock:
+            while len(self._tile_cache) >= self._TILE_CACHE_MAX:
+                old_key = next(iter(self._tile_cache))
+                old = self._tile_cache.pop(old_key)
+                if old is not None and \
+                        self._tile_ident.get(old.ident_key) == old_key:
+                    self._tile_ident.pop(old.ident_key, None)
+            entry.ident_key = ident
+            self._tile_cache[key] = entry
+            if ident is not None:
+                self._tile_ident[ident] = key
 
     def _tile_entry(self, series):
         """Cache of (tiles, idx) built over each series' IMMUTABLE chunk
@@ -522,37 +783,58 @@ class TpuBackend:
         to object identity (holding refs so ids can't be recycled) for
         ad-hoc series. Bounded FIFO.
 
+        A flush changes num_chunks and would historically stall the next
+        query ~tens of ms rebuilding tiles. Now the PREVIOUS snapshot's
+        entry for the same selection identity (same partitions/column,
+        num_chunks abstracted) keeps serving — its ``cov_min_ms`` bounds
+        the device steps, the packed path covers the rest — while the
+        rebuild runs on the batcher's device-executor thread; queries
+        swap to the fresh tiles when it lands.
+
         Known tradeoff: the key covers the whole selection, so overlapping
         selections duplicate tiles and >_TILE_CACHE_MAX distinct selectors
         thrash; per-partition tiles would compose but conflict with cohort
         (shared-cadence) packing, which is what makes the kernels fast."""
-        from filodb_tpu.query import tilestore as tst
-
         use_snap = all(s.snapshot_key is not None for s in series)
         if use_snap:
             key = tuple(s.snapshot_key for s in series)
+            # snapshot key minus the chunk-count field: stable across
+            # flushes for the same partitions + column selection
+            ident = tuple(s.snapshot_key[:3] + s.snapshot_key[4:]
+                          for s in series)
         else:
             key = tuple(id(s) for s in series)
+            ident = None
         with self._tile_lock:
             entry = self._tile_cache.get(key)
+            stale = None
+            if entry is None and ident is not None:
+                old_key = self._tile_ident.get(ident)
+                if old_key is not None:
+                    stale = self._tile_cache.get(old_key)
         if entry is not None:
             self.tile_hits += 1
-        if entry is None:
-            prefix = [
-                RawSeries(s.labels, s.ts[:self._prefix_len(s)],
-                          s.values[:self._prefix_len(s)], s.is_counter,
-                          s.bucket_les)
-                for s in series
-            ]
-            tiles, idx = tst.build_aligned_tiles(prefix)
-            self.tile_builds += 1
-            prefix_has_nan = any(np.isnan(p.values).any() for p in prefix)
-            entry = (tiles, idx, prefix_has_nan,
-                     None if use_snap else list(series))
+            return entry
+        if stale is not None and self.batcher is not None:
+            # stale-but-correct serve + background refresh (once per key)
+            self.tile_hits += 1
             with self._tile_lock:
-                while len(self._tile_cache) >= self._TILE_CACHE_MAX:
-                    self._tile_cache.pop(next(iter(self._tile_cache)))
-                self._tile_cache[key] = entry
+                if key in self._tile_refreshing:
+                    return stale
+                self._tile_refreshing.add(key)
+            held = list(series)     # pin arrays until the rebuild lands
+
+            def refresh():
+                try:
+                    fresh = self._build_tile_entry(held, use_snap)
+                    self._insert_tile_entry(key, ident, fresh)
+                finally:
+                    with self._tile_lock:
+                        self._tile_refreshing.discard(key)
+            self.batcher.executor.submit(refresh)
+            return stale
+        entry = self._build_tile_entry(series, use_snap)
+        self._insert_tile_entry(key, ident, entry)
         return entry
 
     def _try_aligned(self, series, func: str, steps: np.ndarray,
@@ -571,18 +853,22 @@ class TpuBackend:
 
         if func not in tst.ALIGNED_FUNCS:
             return None
-        tiles, idx, prefix_has_nan, _ = self._tile_entry(series)
+        entry = self._tile_entry(series)
+        tiles, idx = entry.tiles, entry.idx
         if func == "last_sample":
             # stale markers must stay visible to the step; the immutable
             # prefix's flag is cached with the tiles, only tails re-scan
-            if prefix_has_nan or any(
+            if entry.prefix_has_nan or any(
                     np.isnan(s.values[self._prefix_len(s):]).any()
                     for s in series):
                 return None
         if tiles is None or len(idx) != len(series):
             return None     # partial alignment: keep one result path
-        # windows ending before the earliest tail sample see only tiles
-        tail_min = None
+        # windows ending before the earliest sample the tiles don't
+        # cover see only tiles: the tail of the CURRENT series, clipped
+        # further by the entry's build-time coverage when a stale entry
+        # is serving across a flush (the rebuild lands in background)
+        tail_min = entry.cov_min_ms
         for s in series:
             cl = self._prefix_len(s)
             if cl < s.ts.size:
@@ -593,18 +879,8 @@ class TpuBackend:
                  else int(np.searchsorted(wends, tail_min, side="left")))
         if t_dev == 0:
             return None     # every window touches live data
-        if func in ("rate", "increase", "delta"):
-            # counter family rides the slot-major f32-hybrid fast path:
-            # int32 timestamps + exact f64 boundary deltas, f32
-            # extrapolation epilogue (~3e-7 relative vs the f64 oracle;
-            # grids wider than int32 ms take the exact path) —
-            # test_tilestore pins parity + the exact fallback
-            out = tst.evaluate_counters_t(tiles, func, steps[:t_dev],
-                                          window_ms, offset_ms).T
-        else:
-            out = tst.evaluate_aligned(tiles, func, steps[:t_dev],
-                                       window_ms, offset_ms, func_args)
-        res = np.asarray(out)
+        res = self._aligned_dispatch(tiles, func, steps[:t_dev],
+                                     window_ms, offset_ms, func_args)
         if len(idx) != res.shape[0]:
             return None
         # restore original series order (build may drop/reorder rows)
@@ -617,6 +893,86 @@ class TpuBackend:
                                             step_ms, window_ms, offset_ms,
                                             func_args)
         return full
+
+    @hot_path
+    def _aligned_dispatch(self, tiles, func: str, steps: np.ndarray,
+                          window_ms: int, offset_ms: int,
+                          func_args) -> np.ndarray:
+        """Aligned-tile kernel dispatch -> [S, T] numpy.
+
+        With the micro-batcher on, concurrent queries over the SAME
+        cached tiles that share (func, step count, step, window) — the
+        dashboard-refresh shape, differing only in grid position — run
+        as ONE vmapped device dispatch along the grid axis. A lone
+        query (or batcher off) takes the scalar evaluator exactly as
+        before; the vmapped families are bit-for-bit the scalar ones
+        (test_batcher pins it)."""
+        from filodb_tpu.query import tilestore as tst
+
+        counters = func in ("rate", "increase", "delta")
+        b = self.batcher
+        nsteps = steps.size
+        if b is not None and b.enabled and not func_args and nsteps >= 1:
+            w0e = int(steps[0] - offset_ms)
+            w0s = w0e - window_ms
+            step = int(steps[1] - steps[0]) if nsteps > 1 else 1
+            if counters:
+                family = tst.counters_batch_family(tiles, func, steps,
+                                                   window_ms, offset_ms)
+            else:
+                family = None
+            # id(tiles) is safe as a key component: members hold a
+            # reference to the tiles object, so the id cannot be
+            # recycled while the batch is open
+            key = ("aligned", id(tiles), func, nsteps, step, window_ms,
+                   family)
+            return b.submit(
+                key, (w0s, w0e, steps, tiles),
+                functools.partial(self._aligned_run, tiles, func,
+                                  family, nsteps, step, window_ms,
+                                  offset_ms))
+        if counters:
+            # counter family rides the slot-major f32-hybrid fast path:
+            # int32 timestamps + exact f64 boundary deltas, f32
+            # extrapolation epilogue (~3e-7 relative vs the f64 oracle;
+            # grids wider than int32 ms take the exact path) —
+            # test_tilestore pins parity + the exact fallback
+            # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+            return np.asarray(tst.evaluate_counters_t(
+                tiles, func, steps, window_ms, offset_ms).T)
+        # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+        return np.asarray(tst.evaluate_aligned(
+            tiles, func, steps, window_ms, offset_ms, func_args))
+
+    def _aligned_run(self, tiles, func: str, family, nsteps: int,
+                     step: int, window_ms: int, offset_ms: int,
+                     members) -> object:
+        """Execute one aligned batch: B=1 takes the scalar evaluator,
+        B>=2 one vmapped dispatch computing every member's grid."""
+        from filodb_tpu.query import tilestore as tst
+        from filodb_tpu.query.batcher import SplitResult
+
+        counters = func in ("rate", "increase", "delta")
+        if len(members) == 1:
+            steps0 = members[0][2]
+            if counters:
+                dev = tst.evaluate_counters_t(tiles, func, steps0,
+                                              window_ms, offset_ms)
+                return SplitResult(dev, 1, split=lambda h, i: h.T)
+            dev = tst.evaluate_aligned(tiles, func, steps0, window_ms,
+                                       offset_ms, ())
+            return SplitResult(dev, 1, split=lambda h, i: h)
+        w0s_list = [m[0] for m in members]
+        w0e_list = [m[1] for m in members]
+        if counters:
+            dev = tst.evaluate_counters_t_batch(
+                tiles, func, family, nsteps, step, w0s_list, w0e_list)
+            # [B_pad, T, S] -> member i's [S, T]
+            return SplitResult(dev, len(members),
+                               split=lambda h, i: h[i].T)
+        dev = tst.evaluate_aligned_batch(
+            tiles, func, nsteps, step, w0s_list, w0e_list)
+        return SplitResult(dev, len(members), split=lambda h, i: h[i])
 
     def fused_groupsum(self, series, func: str, steps: np.ndarray,
                        window_ms: int, offset_ms: int,
@@ -641,11 +997,17 @@ class TpuBackend:
             # nodes take the vectorized-numpy path instead (tests flip
             # the flag to exercise the kernel in interpret mode)
             return None
-        tiles, idx, _, _ = self._tile_entry(series)
+        entry = self._tile_entry(series)
+        tiles, idx = entry.tiles, entry.idx
         if tiles is None or len(idx) != len(series):
             return None
-        # every window must resolve on the immutable prefix: fused
-        # results can't splice a host-side tail scan per group
+        # every window must resolve on the tiles' covered prefix: fused
+        # results can't splice a host-side tail scan per group (a stale
+        # entry serving across a flush covers less than the current
+        # chunk prefix — cov_min_ms is the binding bound)
+        if entry.cov_min_ms is not None and steps.size and \
+                int(steps[-1] - offset_ms) >= entry.cov_min_ms:
+            return None
         for s in series:
             cl = self._prefix_len(s)
             if cl < s.ts.size and steps.size and \

@@ -74,6 +74,18 @@ DEFAULTS = {
     # reset window). Partial responses stay opt-in per request
     # (&allow_partial=true).
     "query-timeout-s": 30.0,
+    # serving fast path (query/batcher.py + query/plancache.py):
+    # micro-batch gather window for concurrent same-shape queries (the
+    # continuous-batching admission layer in front of the TPU backend),
+    # max queries per device dispatch, and the parsed-plan LRU size
+    # (0 disables the respective piece)
+    "batch-gather-window-ms": 1.0,
+    "batch-max": 8,
+    "batch-enabled": True,
+    "plan-cache-size": 256,
+    # admission control: query endpoints admit at most this many
+    # in-flight evaluations (excess parks on a semaphore); 0 = off
+    "max-inflight-queries": 4,
     "peer-retry-attempts": 3,
     "peer-retry-base-delay-s": 0.05,
     "breaker-failure-threshold": 3,
@@ -192,6 +204,15 @@ class FiloServer:
             flush_downsampler=fds)
 
     def start(self) -> "FiloServer":
+        # GIL convoy mitigation on the serving path: handler threads do
+        # short bursts of socket I/O between compute; with CPython's
+        # default 5ms switch interval every GIL reacquisition after a
+        # send/recv can stall a full interval behind a compute-bound
+        # thread. A ~1ms interval keeps request threads interleaving.
+        swi = self.config.get("gil-switch-interval-ms")
+        if swi:
+            import sys as _sys
+            _sys.setswitchinterval(float(swi) / 1000.0)
         n = self.config["num-shards"]
         num_nodes = int(self.config.get("num-nodes", 1))
         ordinal = int(self.config.get("node-ordinal", 0))
@@ -254,8 +275,13 @@ class FiloServer:
             self.mapper.activate(shard)
         if self.backend is None:
             try:
+                from filodb_tpu.query.batcher import MicroBatcher
                 from filodb_tpu.query.tpu import TpuBackend
-                self.backend = TpuBackend()
+                self.backend = TpuBackend(batcher=MicroBatcher(
+                    gather_window_s=float(self.config.get(
+                        "batch-gather-window-ms", 1.0)) / 1000.0,
+                    max_batch=int(self.config.get("batch-max", 8)),
+                    enabled=bool(self.config.get("batch-enabled", True))))
             except Exception:            # device unavailable -> oracle
                 self.backend = None
         mesh_ex = None
@@ -318,7 +344,10 @@ class FiloServer:
                 self.config.get("grpc-partitions") or {}),
             query_timeout_s=float(self.config.get("query-timeout-s",
                                                   30.0)),
-            resilience=resilience)
+            resilience=resilience,
+            plan_cache_size=int(self.config.get("plan-cache-size", 256)),
+            max_inflight_queries=int(self.config.get(
+                "max-inflight-queries", 4)))
         self.http.start()
         self.grpc_server = None
         if self.config.get("grpc-port") is not None:
@@ -354,6 +383,16 @@ class FiloServer:
             self.http.tenant_metering = self.tenant_metering
         if streaming:
             self._start_ingestion()
+        # serving-path GC hygiene: move the (large, permanent) startup
+        # object graph out of the collector's reach and make full
+        # collections 10x rarer — a gen-2 sweep over jax/XLA module
+        # state stalls every in-flight query for ~100ms+ on small hosts
+        if self.config.get("gc-freeze", True):
+            import gc
+            gc.collect()
+            gc.freeze()
+            t0, t1, t2 = gc.get_threshold()
+            gc.set_threshold(t0, t1, max(t2, 100))
         return self
 
     def _start_ingestion(self) -> None:

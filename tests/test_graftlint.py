@@ -406,3 +406,117 @@ def test_cli_json_machine_readable(tmp_path, capsys):
     assert out["findings"][0]["rule"] == "trace-side-effect"
     assert {"path", "line", "message", "severity"} <= \
         set(out["findings"][0])
+
+
+# -- host transfer in hot loops (serving fast path, PR 3) --------------------
+
+HOT_TRANSFER = """
+import numpy as np
+from filodb_tpu.lint.hotpath import hot_path
+
+@hot_path
+def serve_query(x):
+    return np.asarray(x)
+"""
+
+HOT_TRANSFER_PRAGMA = """
+import numpy as np
+from filodb_tpu.lint.hotpath import hot_path
+
+@hot_path
+def serve_query(x):
+    # graftlint: disable=host-transfer-in-hot-loop (single designed sync point)
+    return np.asarray(x)
+"""
+
+HOT_TRANSFER_COLD = """
+import numpy as np
+
+def offline_job(x):
+    return np.asarray(x)        # not marked hot: out of scope
+"""
+
+HOT_TRANSFER_METHOD = """
+from filodb_tpu.lint.hotpath import hot_path
+
+@hot_path
+def serve_query(x):
+    return x.item()
+"""
+
+HOT_TRANSFER_NESTED = """
+import numpy as np
+from filodb_tpu.lint.hotpath import hot_path
+
+@hot_path
+def serve_query(xs):
+    def split(i):
+        return np.asarray(xs)[i]     # nested helper runs in the hot path
+    return split(0)
+"""
+
+HOT_TRANSFER_DUNDER = """
+import numpy as np
+
+__hot_path__ = ("serve_query",)
+
+def serve_query(x):
+    return np.ascontiguousarray(x)
+"""
+
+
+def test_host_transfer_in_hot_loop(tmp_path):
+    assert rules_of(lint_src(tmp_path, HOT_TRANSFER)) \
+        == ["host-transfer-in-hot-loop"]
+    assert not lint_src(tmp_path, HOT_TRANSFER_PRAGMA).findings
+    assert lint_src(tmp_path, HOT_TRANSFER_PRAGMA).suppressed == 1
+    assert not lint_src(tmp_path, HOT_TRANSFER_COLD).findings
+    assert rules_of(lint_src(tmp_path, HOT_TRANSFER_METHOD)) \
+        == ["host-transfer-in-hot-loop"]
+    assert rules_of(lint_src(tmp_path, HOT_TRANSFER_NESTED)) \
+        == ["host-transfer-in-hot-loop"]
+    assert rules_of(lint_src(tmp_path, HOT_TRANSFER_DUNDER)) \
+        == ["host-transfer-in-hot-loop"]
+
+
+# -- CI annotations (--github) -----------------------------------------------
+
+def test_github_annotations_format(tmp_path):
+    from filodb_tpu.lint.ci_annotations import github_annotations
+    res = lint_src(tmp_path, HOT_TRANSFER)
+    lines = github_annotations(res.to_json())
+    assert len(lines) == 1
+    ln = lines[0]
+    assert ln.startswith("::error file=")
+    assert ",line=7," in ln
+    assert "title=graftlint host-transfer-in-hot-loop" in ln
+    assert ln.endswith("syncs device->host on the per-query path")
+
+
+def test_github_annotations_escaping_and_levels():
+    from filodb_tpu.lint.ci_annotations import github_annotations
+    payload = {
+        "findings": [{"path": "a,b:c.py", "line": 3, "rule": "r1",
+                      "severity": "error",
+                      "message": "bad\nthing 100%"}],
+        "baselined": [{"path": "old.py", "line": 9, "rule": "r2",
+                       "severity": "error", "message": "grandfathered"}],
+    }
+    lines = github_annotations(payload)
+    assert lines[0] == ("::error file=a%2Cb%3Ac.py,line=3,"
+                        "title=graftlint r1::bad%0Athing 100%25")
+    assert lines[1].startswith("::warning file=old.py,line=9,")
+
+
+def test_cli_github_flag(tmp_path):
+    import subprocess
+    import sys
+    p = tmp_path / "hot_fixture.py"
+    p.write_text(HOT_TRANSFER)
+    out = subprocess.run(
+        [sys.executable, "-m", "filodb_tpu.lint", "--github",
+         "--no-contracts", str(p)],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert out.stdout.startswith("::error file=")
+    assert "host-transfer-in-hot-loop" in out.stdout

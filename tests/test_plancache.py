@@ -1,0 +1,162 @@
+"""Plan-cache correctness (serving fast path, PR 3).
+
+Pins the ISSUE's contract: the same query text with different
+start/end must HIT the cache and still produce exactly the grids a
+fresh parse would; topology and schema changes invalidate; with the
+cache disabled, responses are byte-identical (golden comparison —
+which also pins the direct-to-bytes matrix encoder against the dict
+path both servers share)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.parallel.shardmapper import ShardStatus
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.plancache import PlanCache
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+
+@pytest.fixture(scope="module")
+def servers():
+    cached = FiloServer({"num-shards": 4, "port": 0}).start()
+    cached.seed_dev_data(n_samples=360, n_instances=4,
+                         start_ms=T0 * 1000)
+    plain = FiloServer({"num-shards": 4, "port": 0,
+                        "plan-cache-size": 0}).start()
+    plain.seed_dev_data(n_samples=360, n_instances=4,
+                        start_ms=T0 * 1000)
+    yield cached, plain
+    cached.stop()
+    plain.stop()
+
+
+def _get_raw(server, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{server.port}{path}?{qs}"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read()
+
+
+QUERIES = [
+    "rate(http_requests_total[5m])",
+    "sum(rate(http_requests_total[5m])) by (instance)",
+    "avg_over_time(heap_usage[10m])",
+    "max(heap_usage) by (instance)",
+    "http_requests_total",
+]
+
+
+def test_cache_hits_produce_identical_bodies(servers):
+    """Same text, sliding ranges: the cached server must answer every
+    request byte-for-byte like the cache-disabled server, while
+    actually serving from the cache (rebased plans)."""
+    cached, plain = servers
+    pc = cached.http.plan_cache
+    base = pc.snapshot()
+    for q in QUERIES:
+        for k in range(4):          # distinct (start, end) per text
+            start = T0 + 600 + k * 97
+            end = start + 900 + k * 60
+            _, body_c = _get_raw(
+                cached, "/promql/timeseries/api/v1/query_range",
+                query=q, start=start, end=end, step=60)
+            _, body_p = _get_raw(
+                plain, "/promql/timeseries/api/v1/query_range",
+                query=q, start=start, end=end, step=60)
+            # identical modulo the timings block (wall-clock values)
+            jc = json.loads(body_c)
+            jp = json.loads(body_p)
+            tc = jc["stats"].pop("timings")
+            tp = jp["stats"].pop("timings")
+            assert jc == jp, (q, start, end)
+            assert tc["planCache"] in ("hit", "miss")
+            assert tp["planCache"] == "off"
+    snap = pc.snapshot()
+    # first occurrence of each text misses, the 3 reruns hit + rebase
+    assert snap["hits"] - base["hits"] >= 3 * len(QUERIES)
+    assert snap["rebases"] - base["rebases"] >= 2 * len(QUERIES)
+
+
+def test_rebased_plan_equals_fresh_parse():
+    pc = PlanCache(capacity=8)
+    q = "sum(rate(http_requests_total[5m])) by (instance)"
+    p0 = parse_query_range(q, TimeStepParams(1000, 60, 2000))
+    pc.store("ds", q, 1000 * 1000, 60 * 1000, 2000 * 1000, p0)
+    got = pc.lookup("ds", q, 3000 * 1000, 60 * 1000, 4200 * 1000)
+    want = parse_query_range(q, TimeStepParams(3000, 60, 4200))
+    assert got == want          # dataclass tree equality
+    # exact-range hit returns the canonical plan itself
+    assert pc.lookup("ds", q, 1000 * 1000, 60 * 1000,
+                     2000 * 1000) is p0
+
+
+def test_uncacheable_shapes_are_not_stored():
+    pc = PlanCache(capacity=8)
+    # @-pinned evaluation does not rebase on the grid -> uncacheable
+    q = "rate(http_requests_total[5m] @ 1500)"
+    plan = parse_query_range(q, TimeStepParams(1000, 60, 2000))
+    pc.store("ds", q, 1000 * 1000, 60 * 1000, 2000 * 1000, plan)
+    assert len(pc) == 0
+    assert pc.snapshot()["uncacheable"] == 1
+    # subqueries are not lp_replace_range-rewritable either
+    q2 = "max_over_time(rate(http_requests_total[1m])[10m:1m])"
+    plan2 = parse_query_range(q2, TimeStepParams(1000, 60, 2000))
+    pc.store("ds", q2, 1000 * 1000, 60 * 1000, 2000 * 1000, plan2)
+    assert len(pc) == 0
+
+
+def test_topology_change_invalidates(servers):
+    cached, _ = servers
+    pc = cached.http.plan_cache
+    _get_raw(cached, "/promql/timeseries/api/v1/query_range",
+             query=QUERIES[0], start=T0 + 600, end=T0 + 1500, step=60)
+    assert len(pc) > 0
+    inv0 = pc.snapshot()["invalidations"]
+    # a shard status transition is a topology change: mapper events
+    # clear the cache
+    cached.mapper.update(0, ShardStatus.DOWN, cached.node_id)
+    assert len(pc) == 0
+    assert pc.snapshot()["invalidations"] > inv0
+    cached.mapper.update(0, ShardStatus.ACTIVE, cached.node_id)
+
+
+def test_schema_change_hook_invalidates(servers):
+    cached, _ = servers
+    pc = cached.http.plan_cache
+    _get_raw(cached, "/promql/timeseries/api/v1/query_range",
+             query=QUERIES[0], start=T0 + 600, end=T0 + 1500, step=60)
+    assert len(pc) > 0
+    inv0 = pc.snapshot()["invalidations"]
+    cached.http.invalidate_plan_cache("schema")
+    assert len(pc) == 0
+    assert pc.snapshot()["invalidations"] == inv0 + 1
+
+
+def test_instant_queries_cache_and_match(servers):
+    cached, plain = servers
+    for t in (T0 + 900, T0 + 1200):
+        _, c = _get_raw(cached, "/promql/timeseries/api/v1/query",
+                        query="max(heap_usage) by (instance)", time=t)
+        _, p = _get_raw(plain, "/promql/timeseries/api/v1/query",
+                        query="max(heap_usage) by (instance)", time=t)
+        assert json.loads(c)["data"] == json.loads(p)["data"]
+
+
+def test_lru_eviction():
+    pc = PlanCache(capacity=2)
+    q = "rate(http_requests_total[5m])"
+    for i in range(3):
+        plan = parse_query_range(f"{q} + {i}",
+                                 TimeStepParams(1000, 60, 2000))
+        pc.store("ds", f"{q} + {i}", 1000 * 1000, 60 * 1000,
+                 2000 * 1000, plan)
+    assert len(pc) == 2
+    assert pc.lookup("ds", f"{q} + 0", 1000 * 1000, 60 * 1000,
+                     2000 * 1000) is None   # evicted (LRU)
+    assert pc.lookup("ds", f"{q} + 2", 1000 * 1000, 60 * 1000,
+                     2000 * 1000) is not None
