@@ -134,12 +134,66 @@ def _scrape_metric(client, name):
     except (OSError, AssertionError):
         return 0.0
     for ln in body.splitlines():
-        if ln.startswith(f"filodb_{name}{{"):
+        # family with labels or bare (label-less gauges print no braces)
+        if ln.startswith(f"filodb_{name}{{") \
+                or ln.startswith(f"filodb_{name} "):
             try:
                 return float(ln.rsplit(" ", 1)[1])
             except ValueError:
                 return 0.0
     return 0.0
+
+
+def _scrape_histogram(client, name):
+    """{le_seconds: cumulative_count} + total count for one histogram
+    family from /metrics (filodb_<name>_bucket lines)."""
+    try:
+        body = client.get_raw("/metrics").decode()
+    except (OSError, AssertionError):
+        return {}, 0
+    buckets = {}
+    count = 0
+    for ln in body.splitlines():
+        if ln.startswith(f"filodb_{name}_bucket{{le="):
+            le_s = ln.split('le="', 1)[1].split('"', 1)[0]
+            le = float("inf") if le_s == "+Inf" else float(le_s)
+            buckets[le] = float(ln.rsplit(" ", 1)[1])
+        elif ln.startswith(f"filodb_{name}_count"):
+            count = float(ln.rsplit(" ", 1)[1])
+    return buckets, count
+
+
+def _hist_quantiles(b0, c0, b1, c1, qs=(0.5, 0.95, 0.99)):
+    """Quantiles (ms) from the DELTA of two cumulative-bucket
+    snapshots — i.e. what a PromQL histogram_quantile(rate(...)) would
+    report for the measurement window (linear interpolation within the
+    winning bucket)."""
+    les = sorted(b1)
+    deltas = []
+    prev = 0.0
+    for le in les:
+        cum = b1[le] - b0.get(le, 0.0)
+        deltas.append(cum - prev)
+        prev = cum
+    total = c1 - c0
+    if total <= 0:
+        return {q: float("nan") for q in qs}
+    out = {}
+    for q in qs:
+        rank = q * total
+        cum = 0.0
+        lo = 0.0
+        val = les[-1]
+        for le, d in zip(les, deltas):
+            if cum + d >= rank:
+                hi = le if le != float("inf") else lo
+                frac = (rank - cum) / d if d else 0.0
+                val = lo + (hi - lo) * frac
+                break
+            cum += d
+            lo = le
+        out[q] = val * 1000.0
+    return out
 
 
 def measure():
@@ -278,6 +332,7 @@ def measure():
 
             b0 = _scrape_metric(warm, "batcher_batches_total")
             q0 = _scrape_metric(warm, "batcher_queries_total")
+            hb0, hc0 = _scrape_histogram(warm, "query_latency_seconds")
             t0 = time.perf_counter()
             t_end[0] = t0 + duration_s
             threads = [threading.Thread(target=client_loop, args=(c,))
@@ -289,8 +344,14 @@ def measure():
             wall = time.perf_counter() - t0
             b1 = _scrape_metric(warm, "batcher_batches_total")
             q1 = _scrape_metric(warm, "batcher_queries_total")
+            hb1, hc1 = _scrape_histogram(warm, "query_latency_seconds")
             lats_ms = np.asarray(lats) * 1000
             occ = (q1 - q0) / (b1 - b0) if b1 > b0 else 1.0
+            # server-side quantiles derived from the /metrics histogram
+            # delta over this level — the scrapeable answer to the same
+            # question the client-side percentiles measure (bucket
+            # resolution, so expect agreement to the bucket width)
+            hq = _hist_quantiles(hb0, hc0, hb1, hc1)
             return {
                 "clients": clients,
                 "queries": len(lats),
@@ -298,6 +359,9 @@ def measure():
                 "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
                 "p95_ms": round(float(np.percentile(lats_ms, 95)), 2),
                 "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+                "hist_p50_ms": round(hq[0.5], 2),
+                "hist_p95_ms": round(hq[0.95], 2),
+                "hist_p99_ms": round(hq[0.99], 2),
                 "batcher_occupancy": round(occ, 2),
             }, (timings[-1] if timings else {})
 
@@ -321,6 +385,9 @@ def measure():
             "unit": "ms",
             "p95_ms": headline["p95_ms"],
             "p99_ms": headline["p99_ms"],
+            "hist_p50_ms": headline["hist_p50_ms"],
+            "hist_p95_ms": headline["hist_p95_ms"],
+            "hist_p99_ms": headline["hist_p99_ms"],
             "qps": headline["e2e_qps"],
             "clients": headline["clients"],
             "queries": headline["queries"],

@@ -11,22 +11,48 @@ fixed interval into gauges the /metrics exposition serves."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, Mapping, Tuple
+import time
+from typing import Dict, Mapping, Optional, Tuple
 
 
 class TenantMetering:
-    """Periodic depth-2 (workspace, namespace) cardinality snapshots."""
+    """Periodic depth-2 (workspace, namespace) cardinality snapshots.
+
+    Daemon-thread lifecycle contract (the reference's
+    TenantIngestionMetering runs on the coordinator scheduler and dies
+    with it): ``start()`` takes an eager first snapshot and spawns the
+    loop; ``stop()`` is idempotent, joins the thread, and after it
+    returns ``alive`` is False — the standalone server calls it on
+    shutdown so no metering thread outlives the process teardown.
+    ``last_snapshot_age_s`` is exported in /metrics so a stalled or
+    dead loop shows as a growing age instead of silently-stale
+    gauges."""
 
     def __init__(self, trackers: Mapping[int, object],
                  interval_s: float = 60.0, depth: int = 2):
         self.trackers = trackers          # shard -> CardinalityTracker
-        self.interval_s = interval_s
+        self.interval_s = float(interval_s)
         self.depth = depth
         # (ws, ns) -> (ts_count, active_ts_count); swapped atomically
         self.latest: Dict[Tuple[str, ...], Tuple[int, int]] = {}
         self.snapshots = 0
+        self.last_snapshot_t: Optional[float] = None   # monotonic
         self._stop = threading.Event()
-        self._thread = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def alive(self) -> bool:
+        """True while the snapshot thread is running (False before
+        start and after a completed stop/join)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def last_snapshot_age_s(self) -> Optional[float]:
+        """Seconds since the last completed snapshot (None before the
+        first one) — the loop-liveness gauge."""
+        if self.last_snapshot_t is None:
+            return None
+        return time.monotonic() - self.last_snapshot_t
 
     def snapshot_once(self) -> None:
         agg: Dict[Tuple[str, ...], Tuple[int, int]] = {}
@@ -39,6 +65,7 @@ class TenantMetering:
                                    a + rec.active_ts_count)
         self.latest = agg                 # atomic rebind for readers
         self.snapshots += 1
+        self.last_snapshot_t = time.monotonic()
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -49,12 +76,18 @@ class TenantMetering:
 
     def start(self) -> "TenantMetering":
         self.snapshot_once()
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tenant-metering")
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop + JOIN the snapshot thread (idempotent; safe to call
+        before start)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if not t.is_alive():
+                self._thread = None

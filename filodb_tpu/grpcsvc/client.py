@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from filodb_tpu.grpcsvc import wire
+from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
@@ -129,13 +130,24 @@ class GrpcShardGroup:
     def fetch_raw(self, filters, start_ms: int, end_ms: int,
                   column: Optional[str],
                   full: bool = True) -> List[RawSeries]:
+        with obs_trace.span("remote-peer", node=self.node_id,
+                            plane="grpc", rpc="FetchRaw",
+                            addr=self.addr):
+            return self._fetch_raw(filters, start_ms, end_ms, column,
+                                   full)
+
+    def _fetch_raw(self, filters, start_ms: int, end_ms: int,
+                   column: Optional[str],
+                   full: bool = True) -> List[RawSeries]:
         def dial(timeout_s: float) -> bytes:
             # payload re-encoded per attempt: a retry must forward the
-            # REMAINING budget, not the original one
+            # REMAINING budget, not the original one (the trace context
+            # is re-read too: the parent is the live attempt span)
             payload = wire.encode_raw_request(
                 self.dataset, filters, start_ms, end_ms, column,
                 self.shard_nums, span_snap=bool(full),
-                deadline_ms=self._deadline_ms())
+                deadline_ms=self._deadline_ms(),
+                trace_ctx=obs_trace.inject_header() or "")
             return _call(self.addr, "FetchRaw", payload, timeout_s,
                          self.node_id)
 
@@ -148,9 +160,12 @@ class GrpcShardGroup:
             if self.http_fallback is None:
                 raise
             # binary plane down: downgrade to the JSON control plane
+            obs_trace.event("plane-fallback", node=self.node_id,
+                            to="http")
             return self._http_group().fetch_raw(
                 filters, start_ms, end_ms, column, full=full)
-        series, error = wire.decode_raw_response(buf)
+        series, error, spans = wire.decode_raw_response(buf)
+        obs_trace.absorb_wire(spans)      # stitch the peer's subspans
         if error:
             raise QueryError(f"remote node {self.node_id}: {error}")
         return series
@@ -206,6 +221,11 @@ class GrpcRemoteExec:
         return max(int(self.deadline.remaining() * 1000), 1)
 
     def execute(self):
+        with obs_trace.span("remote-peer", node=self.node_id,
+                            plane="grpc", rpc="Exec", addr=self.addr):
+            return self._execute()
+
+    def _execute(self):
         from filodb_tpu.query.model import GridResult, RangeParams
 
         def dial(timeout_s: float) -> bytes:
@@ -214,7 +234,8 @@ class GrpcRemoteExec:
                 self.dataset, self.query, self.start_ms, self.step_ms,
                 self.end_ms, local_only=self.local_only,
                 plan_wire=self.plan_wire,
-                deadline_ms=self._deadline_ms())
+                deadline_ms=self._deadline_ms(),
+                trace_ctx=obs_trace.inject_header() or "")
             return _call(self.addr, "Exec", payload, timeout_s,
                          self.node_id)
 
@@ -229,9 +250,12 @@ class GrpcRemoteExec:
             # the HTTP edge can't carry a structural plan; only PromQL-
             # printable pushdowns downgrade (the planner only sets
             # http_fallback when a query string exists)
+            obs_trace.event("plane-fallback", node=self.node_id,
+                            to="http")
             return self._fallback_exec().execute()
         steps, keys, values, hv, les, stats, error = \
             wire.decode_exec_response(buf)
+        obs_trace.absorb_wire(stats.get("trace_spans"))
         if error:
             raise QueryError(f"remote node {self.node_id}: {error}")
         partial = bool(stats.get("partial"))

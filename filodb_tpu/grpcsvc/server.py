@@ -20,6 +20,7 @@ from typing import Optional
 
 from filodb_tpu.grpcsvc import wire
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.obs import trace as obs_trace
 
 _SERVICE = "filodb.QueryService"
 
@@ -81,27 +82,52 @@ class GrpcQueryServer:
             return None
         return Deadline.after(min(ms / 1000.0, default_timeout_s))
 
+    def _req_trace(self, req):
+        """A local Trace for a propagated context (trace propagation on
+        the binary plane): spans recorded here ship back in the
+        response and the CALLER's recorder stitches them — nothing is
+        stored on this node. None (no tracing at all) when the caller
+        didn't forward a context."""
+        ctx = obs_trace.parse_context(req.get("trace"))
+        if ctx is None:
+            return None
+        tracer = getattr(self.http, "tracer", None)
+        if tracer is not None:
+            return tracer.start(ctx)
+        return obs_trace.Trace(ctx[0], root_parent=ctx[1])
+
     def _fetch_raw(self, request: bytes, context) -> bytes:
         from filodb_tpu.query.model import QueryError, QueryStats
         with self._rpc_lock:
             self.rpcs_served += 1
+        tr = None
         try:
             req = wire.decode_raw_request(request)
-            series = self.http.leaf_select(
-                req["dataset"], req["filters"], req["start_ms"],
-                req["end_ms"], req["column"], req["shards"],
-                span_snap=req["span_snap"], stats=QueryStats(),
-                deadline=self._req_deadline(
-                    req, getattr(self.http, "query_timeout_s", 30.0)))
+            tr = self._req_trace(req)
+            with obs_trace.activate(tr), \
+                    obs_trace.span("peer-fetch-raw",
+                                   node=getattr(self.http, "node_id", "")
+                                   or "", dataset=req["dataset"]):
+                series = self.http.leaf_select(
+                    req["dataset"], req["filters"], req["start_ms"],
+                    req["end_ms"], req["column"], req["shards"],
+                    span_snap=req["span_snap"], stats=QueryStats(),
+                    deadline=self._req_deadline(
+                        req, getattr(self.http, "query_timeout_s",
+                                     30.0)))
             if series is None:
                 return wire.encode_raw_response(
-                    [], error=f"dataset {req['dataset']} not set up")
-            return wire.encode_raw_response(series)
+                    [], error=f"dataset {req['dataset']} not set up",
+                    trace_spans=obs_trace.spans_wire(tr))
+            return wire.encode_raw_response(
+                series, trace_spans=obs_trace.spans_wire(tr))
         except QueryError as e:
-            return wire.encode_raw_response([], error=str(e))
+            return wire.encode_raw_response(
+                [], error=str(e), trace_spans=obs_trace.spans_wire(tr))
         except Exception as e:           # wire errors back, never crash
             return wire.encode_raw_response(
-                [], error=f"internal: {type(e).__name__}: {e}")
+                [], error=f"internal: {type(e).__name__}: {e}",
+                trace_spans=obs_trace.spans_wire(tr))
 
     def _exec(self, request: bytes, context) -> bytes:
         from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
@@ -110,34 +136,47 @@ class GrpcQueryServer:
                                             ScalarResult)
         with self._rpc_lock:
             self.rpcs_served += 1
+        tr = None
         try:
             req = wire.decode_exec_request(request)
+            tr = self._req_trace(req)
             engine = self.http.make_planner(
                 req["dataset"], local_dispatch=req["local_only"],
                 deadline=self._req_deadline(
                     req, getattr(self.http, "query_timeout_s", 30.0)))
             if engine is None:
                 return wire.encode_exec_response(
-                    None, error=f"dataset {req['dataset']} not set up")
-            if req["plan_wire"]:
-                # structural plan tree: no PromQL printer/parser in the
-                # loop (exec_plan.proto capability)
-                from filodb_tpu.query.planwire import plan_from_wire
-                plan = plan_from_wire(req["plan_wire"])
-            elif req["step_ms"] > 0:
-                plan = parse_query_range(
-                    req["query"],
-                    TimeStepParams(req["start_ms"] // 1000,
-                                   req["step_ms"] // 1000,
-                                   req["end_ms"] // 1000))
-            else:
-                plan = parse_query(req["query"], req["start_ms"] // 1000)
-            res = engine.execute(plan)
+                    None, error=f"dataset {req['dataset']} not set up",
+                    trace_spans=obs_trace.spans_wire(tr))
+            with obs_trace.activate(tr), \
+                    obs_trace.span("peer-exec",
+                                   node=getattr(self.http, "node_id", "")
+                                   or "", dataset=req["dataset"]):
+                if req["plan_wire"]:
+                    # structural plan tree: no PromQL printer/parser in
+                    # the loop (exec_plan.proto capability)
+                    from filodb_tpu.query.planwire import plan_from_wire
+                    plan = plan_from_wire(req["plan_wire"])
+                elif req["step_ms"] > 0:
+                    plan = parse_query_range(
+                        req["query"],
+                        TimeStepParams(req["start_ms"] // 1000,
+                                       req["step_ms"] // 1000,
+                                       req["end_ms"] // 1000))
+                else:
+                    plan = parse_query(req["query"],
+                                       req["start_ms"] // 1000)
+                res = engine.execute(plan)
             if isinstance(res, ScalarResult):
                 res = GridResult(res.steps, [{}], res.values[None, :])
-            return wire.encode_exec_response(res, stats=engine.stats)
+            return wire.encode_exec_response(
+                res, stats=engine.stats,
+                trace_spans=obs_trace.spans_wire(tr))
         except QueryError as e:
-            return wire.encode_exec_response(None, error=str(e))
+            return wire.encode_exec_response(
+                None, error=str(e),
+                trace_spans=obs_trace.spans_wire(tr))
         except Exception as e:
             return wire.encode_exec_response(
-                None, error=f"internal: {type(e).__name__}: {e}")
+                None, error=f"internal: {type(e).__name__}: {e}",
+                trace_spans=obs_trace.spans_wire(tr))

@@ -15,22 +15,33 @@ Messages (field numbers):
   Filter        {1: label, 2: op, 3: value}
   RawRequest    {1: dataset, 2: Filter*, 3: start_ms, 4: end_ms,
                  5: column, 6: shards packed, 7: span_snap,
-                 8: deadline_ms (caller's remaining budget; 0 = none)}
+                 8: deadline_ms (caller's remaining budget; 0 = none),
+                 9: trace ctx "trace_id-parent_span-1" (absent = untraced)}
   SnapKey       {1: node, 2: ds, 3: shard, 4: part, 5: num_chunks,
                  6: col, 7: start_ms, 8: end_ms}
   Srv           {1: label entry {1:k,2:v}*, 2: n, 3: ts nibble,
                  4: vals nibble, 5: is_counter, 6: nb, 7: les f64le,
                  8: drops nibble, 9: chunk_len+1, 10: SnapKey}
-  RawResponse   {1: Srv*, 2: error}
+  RawResponse   {1: Srv*, 2: error,
+                 3: trace spans (JSON list; present only when traced)}
   ExecRequest   {1: dataset, 2: query, 3: start_ms, 4: step_ms,
                  5: end_ms, 6: local_only, 7: hist_wire,
-                 9: deadline_ms (caller's remaining budget; 0 = none)}
+                 9: deadline_ms (caller's remaining budget; 0 = none),
+                 10: trace ctx "trace_id-parent_span-1"}
   ExecSeries    {1: label entry*, 2: values nibble (grid-aligned,
                  NaN where absent), 3: hist nibble flat, 4: nb}
   ExecResponse  {1: ExecSeries*, 2: error, 3: steps nibble,
                  4: series_scanned, 5: samples_scanned,
                  6: les f64le, 7: scalar flag, 8: partial flag,
-                 9: warning string*}
+                 9: warning string*,
+                 10: trace spans (JSON list; present only when traced)}
+
+The trace fields carry the Dapper-style propagated context (obs/trace):
+the caller forwards its trace id + parent span id; the peer records its
+spans under that parent and ships them back, so the entry node's
+recorder holds ONE stitched trace across the gRPC plane. Span payloads
+ride as JSON — they exist only on sampled traces, so wire compactness
+is irrelevant next to the NibblePack sample columns.
 """
 
 from __future__ import annotations
@@ -96,7 +107,8 @@ def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
                        column: Optional[str],
                        shards: Optional[Sequence[int]],
                        span_snap: bool = True,
-                       deadline_ms: int = 0) -> bytes:
+                       deadline_ms: int = 0,
+                       trace_ctx: str = "") -> bytes:
     out = bytearray(_ld(1, dataset.encode()))
     for f in filters:
         out += _ld(2, _ld(1, f.label.encode()) + _ld(2, f.op.encode())
@@ -109,6 +121,8 @@ def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
     out += _vi(7, 1 if span_snap else 0)
     if deadline_ms > 0:
         out += _vi(8, int(deadline_ms))
+    if trace_ctx:
+        out += _ld(9, trace_ctx.encode())
     return bytes(out)
 
 
@@ -116,7 +130,7 @@ def decode_raw_request(buf: bytes) -> Dict:
     from filodb_tpu.core.index import ColumnFilter
     req = {"dataset": "", "filters": [], "start_ms": 0, "end_ms": 0,
            "column": None, "shards": None, "span_snap": True,
-           "deadline_ms": 0}
+           "deadline_ms": 0, "trace": ""}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -146,6 +160,8 @@ def decode_raw_request(buf: bytes) -> Dict:
             req["span_snap"] = bool(v)
         elif f == 8:
             req["deadline_ms"] = _signed(v)
+        elif f == 9:
+            req["trace"] = v.decode()
     return req
 
 
@@ -238,24 +254,31 @@ def decode_series(buf: bytes) -> RawSeries:
 
 
 def encode_raw_response(series: Sequence[RawSeries],
-                        error: str = "") -> bytes:
+                        error: str = "",
+                        trace_spans: bytes = b"") -> bytes:
     out = bytearray()
     for s in series:
         out += _ld(1, encode_series(s))
     if error:
         out += _ld(2, error.encode())
+    if trace_spans:
+        out += _ld(3, trace_spans)
     return bytes(out)
 
 
 def decode_raw_response(buf: bytes):
+    """-> (series, error, trace_spans_bytes)."""
     series: List[RawSeries] = []
     error = ""
+    trace_spans = b""
     for f, _, v in _fields(buf):
         if f == 1:
             series.append(decode_series(v))
         elif f == 2:
             error = v.decode()
-    return series, error
+        elif f == 3:
+            trace_spans = v
+    return series, error, trace_spans
 
 
 # -- Exec (whole-query pushdown / federation) --------------------------------
@@ -264,12 +287,14 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
                         step_ms: int, end_ms: int,
                         local_only: bool = True,
                         plan_wire: bytes = b"",
-                        deadline_ms: int = 0) -> bytes:
+                        deadline_ms: int = 0,
+                        trace_ctx: str = "") -> bytes:
     """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
     the reference's exec_plan.proto capability; the printed query text
     stays alongside for debuggability and older peers. Field 9 carries
     the caller's remaining deadline budget in ms (server-side deadline
-    propagation; 0/absent = none)."""
+    propagation; 0/absent = none). Field 10 carries the propagated
+    trace context (absent = untraced)."""
     out = (_ld(1, dataset.encode()) + _ld(2, query.encode())
            + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
            + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
@@ -277,13 +302,15 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
         out += _ld(8, plan_wire)
     if deadline_ms > 0:
         out += _vi(9, int(deadline_ms))
+    if trace_ctx:
+        out += _ld(10, trace_ctx.encode())
     return out
 
 
 def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
            "end_ms": 0, "local_only": True, "plan_wire": b"",
-           "deadline_ms": 0}
+           "deadline_ms": 0, "trace": ""}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -301,14 +328,20 @@ def decode_exec_request(buf: bytes) -> Dict:
             req["plan_wire"] = v
         elif f == 9:
             req["deadline_ms"] = _signed(v)
+        elif f == 10:
+            req["trace"] = v.decode()
     return req
 
 
-def encode_exec_response(grid, stats=None, error: str = "") -> bytes:
+def encode_exec_response(grid, stats=None, error: str = "",
+                         trace_spans: bytes = b"") -> bytes:
     """GridResult -> ExecResponse (grid-aligned nibble-packed rows)."""
     out = bytearray()
     if error:
-        return bytes(_ld(2, error.encode()))
+        out += _ld(2, error.encode())
+        if trace_spans:
+            out += _ld(10, trace_spans)
+        return bytes(out)
     steps = np.asarray(grid.steps, np.int64)
     out += _ld(3, _uvarint(steps.size) + _pack_i64(steps))
     nb = 0
@@ -339,17 +372,20 @@ def encode_exec_response(grid, stats=None, error: str = "") -> bytes:
         out += _vi(8, 1)
     for w in warnings:
         out += _ld(9, str(w).encode())
+    if trace_spans:
+        out += _ld(10, trace_spans)
     return bytes(out)
 
 
 def decode_exec_response(buf: bytes):
     """-> (steps i64, keys, values [S,T], hist [S,T,nb]|None, les|None,
-    stats dict, error)."""
+    stats dict, error). The peer's trace spans (if any) ride
+    ``stats["trace_spans"]`` as raw JSON bytes."""
     steps = np.zeros(0, np.int64)
     rows = []
     les = None
     stats = {"seriesScanned": 0, "samplesScanned": 0,
-             "partial": False, "warnings": []}
+             "partial": False, "warnings": [], "trace_spans": b""}
     error = ""
     for f, _, v in _fields(buf):
         if f == 3:
@@ -368,6 +404,8 @@ def decode_exec_response(buf: bytes):
             stats["partial"] = bool(v)
         elif f == 9:
             stats["warnings"].append(v.decode())
+        elif f == 10:
+            stats["trace_spans"] = v
     if error:
         return None, [], None, None, None, stats, error
     # nibble streams decode in 8-word groups, so counts ride explicitly

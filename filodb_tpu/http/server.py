@@ -24,6 +24,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from filodb_tpu.http import prom_json
+from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.obs.slowlog import InflightRegistry, SlowQueryLog
+from filodb_tpu.obs.trace import Tracer
 from filodb_tpu.parallel.resilience import (Deadline, DeadlineExceeded,
                                             PeerResilience)
 from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
@@ -35,6 +39,9 @@ from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
                                     QueryLimits, ScalarResult)
 
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
+
+_QLAT_HELP = ("End-to-end query latency in seconds at the HTTP edge "
+              "(parse + plan + execute + encode)")
 
 
 class _Handled(Exception):
@@ -75,7 +82,10 @@ class FiloHttpServer:
                  query_timeout_s: float = 30.0,
                  resilience: Optional[PeerResilience] = None,
                  plan_cache_size: int = 256,
-                 max_inflight_queries: int = 4):
+                 max_inflight_queries: int = 4,
+                 tracer: Optional[Tracer] = None,
+                 slow_query_ms: float = 1000.0,
+                 slow_query_capacity: int = 128):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -106,6 +116,17 @@ class FiloHttpServer:
         # set by the standalone server: FailureDetector whose down-view
         # rides the health body (quorum input for elastic reassignment)
         self.detector = None
+        # observability spine (filodb_tpu.obs): the tracer owns the
+        # sampling decision + the bounded ring behind /debug/traces;
+        # the slow-query log and in-flight registry serve
+        # /debug/slow_queries and /debug/queries. Tracing defaults OFF
+        # — span() stays on its no-op path and responses are
+        # byte-identical to the untraced build.
+        self.tracer = tracer if tracer is not None \
+            else Tracer(enabled=False, node=node_id or "")
+        self.slow_log = SlowQueryLog(threshold_ms=float(slow_query_ms),
+                                     capacity=int(slow_query_capacity))
+        self.inflight = InflightRegistry()
         # admission control on the QUERY endpoints: with hundreds of
         # keep-alive connections, unbounded in-flight handlers thrash
         # the GIL (every runnable thread pays switch-interval
@@ -241,8 +262,13 @@ class FiloHttpServer:
                         qs.setdefault(k, []).extend(v)
                 elif "application/json" in ctype and body_raw:
                     body_json = json.loads(body_raw)
+            # propagated trace context (Dapper-style): a peer hop's
+            # header makes this node record spans under the caller's
+            # trace and ship them back in the response envelope
+            tctx = obs_trace.parse_context(
+                req.headers.get(obs_trace.HEADER))
             code, payload = self._route(parsed.path, qs, body_json,
-                                        body_raw)
+                                        body_raw, tctx=tctx)
         except _Handled:
             pass
         except QueryLimitError as e:
@@ -278,7 +304,7 @@ class FiloHttpServer:
         req.wfile.write(body)
 
     def _route(self, path: str, qs: Dict, body_json=None,
-               body_raw: bytes = b""):
+               body_raw: bytes = b"", tctx=None):
         if path in ("/__health", "/__liveness", "/__readiness"):
             # the health body doubles as status gossip: locally-served
             # shards with their FSM status (peers sync these instead of
@@ -308,12 +334,23 @@ class FiloHttpServer:
             return 200, body
         if path == "/metrics":
             return 200, self._metrics_text()
+        if path == "/debug/traces":
+            return 200, self._debug_traces(qs)
+        if path == "/debug/queries":
+            return 200, {"status": "success",
+                         "data": self.inflight.snapshot()}
+        if path == "/debug/slow_queries":
+            limit = int(self._param(qs, "limit", "50") or 50)
+            return 200, {"status": "success",
+                         "summary": self.slow_log.snapshot(),
+                         "data": self.slow_log.records(limit)}
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
         if m:
             return 200, self._cluster_status(m.group("ds"))
         m = re.match(r"^/api/v1/raw/(?P<ds>[^/]+)$", path)
         if m:
-            return self._raw_dispatch(m.group("ds"), body_json)
+            return self._raw_dispatch(m.group("ds"), body_json,
+                                      tctx=tctx)
         m = re.match(r"^/api/v1/cardinality/(?P<ds>[^/]+)$", path)
         if m:
             return self._cardinality(m.group("ds"), qs)
@@ -343,14 +380,14 @@ class FiloHttpServer:
             return 400, prom_json.error(f"dataset {ds} not set up")
         if rest == "query_range":
             if self._query_gate is None:
-                return self._query_range(engine, qs, ds)
+                return self._query_range(engine, qs, ds, tctx=tctx)
             with self._query_gate:
-                return self._query_range(engine, qs, ds)
+                return self._query_range(engine, qs, ds, tctx=tctx)
         if rest == "query":
             if self._query_gate is None:
-                return self._query_instant(engine, qs, ds)
+                return self._query_instant(engine, qs, ds, tctx=tctx)
             with self._query_gate:
-                return self._query_instant(engine, qs, ds)
+                return self._query_instant(engine, qs, ds, tctx=tctx)
         if rest == "labels":
             return self._labels(engine, qs, ds)
         lm = re.match(r"^label/(?P<name>[^/]+)/values$", rest)
@@ -425,7 +462,8 @@ class FiloHttpServer:
         except ValueError:
             return default_s
 
-    def _query_range(self, engine, qs, ds: str = "timeseries"):
+    def _query_range(self, engine, qs, ds: str = "timeseries",
+                     tctx=None):
         import time as _time
         query = self._param(qs, "query")
         if not query:
@@ -435,69 +473,230 @@ class FiloHttpServer:
         step = int(float(self._param(qs, "step", "10")))
         if end < start:
             raise QueryError("end < start")
-        # query-path spans (the Kamon span surface, QueryActor.scala:113:
-        # parse -> materialize -> execute timings ride the response stats)
+        # tracing: a propagated context (peer hop) is always honored;
+        # fresh requests sample per tracer policy; &explain=trace forces
+        # a trace for this one request and inlines it in the response
+        explain_trace = self._param(qs, "explain") == "trace"
+        tr = self.tracer.start(tctx, force=explain_trace)
+        entry = self.inflight.register(
+            query, ds, kind="range",
+            trace_id=tr.trace_id if tr is not None else None)
+        stages: Dict[str, object] = {}
         t0 = _time.perf_counter()
-        plan = self.plan_cache.lookup(ds, query, start * 1000,
-                                      step * 1000, end * 1000)
-        cached = plan is not None
-        if plan is None:
-            plan = parse_query_range(query,
-                                     TimeStepParams(start, step, end))
-            self.plan_cache.store(ds, query, start * 1000, step * 1000,
-                                  end * 1000, plan)
+        try:
+            with obs_trace.activate(tr):
+                with obs_trace.span("query", query=query, dataset=ds,
+                                    node=self.node_id or ""):
+                    code, payload = self._query_range_stages(
+                        engine, qs, ds, query, start, end, step, entry,
+                        stages, force_dict=tr is not None)
+            if tr is not None and isinstance(payload, dict):
+                if tctx is not None:
+                    # peer hop: ship the local spans back; the entry
+                    # node's recorder stitches them into ONE trace
+                    payload["trace_spans"] = tr.spans_json()
+                else:
+                    self.tracer.finish(tr)
+                    if explain_trace:
+                        payload["trace"] = tr.to_json()
+            elif tr is not None and tctx is None:
+                self.tracer.finish(tr)
+            return code, payload
+        finally:
+            total_s = _time.perf_counter() - t0
+            self.inflight.unregister(entry)
+            obs_metrics.observe("filodb_query_latency_seconds",
+                                _QLAT_HELP, total_s)
+            self._maybe_slow_log(total_s, query, ds, "range", engine,
+                                 stages, tr)
+
+    def _query_range_stages(self, engine, qs, ds, query, start, end,
+                            step, entry, stages, force_dict=False):
+        """The staged range-query path: parse (plan cache) ->
+        materialize -> execute -> encode, with per-stage spans, the
+        in-flight registry's stage pointer, and the ``stages``
+        breakdown the slow-query log records. ``force_dict`` routes the
+        encode off the pre-encoded fast path so trace keys can attach
+        (only set when a trace is active)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        self.inflight.stage(entry, "parse")
+        with obs_trace.span("parse") as sp:
+            plan = self.plan_cache.lookup(ds, query, start * 1000,
+                                          step * 1000, end * 1000)
+            cached = plan is not None
+            if plan is None:
+                plan = parse_query_range(query,
+                                         TimeStepParams(start, step, end))
+                self.plan_cache.store(ds, query, start * 1000,
+                                      step * 1000, end * 1000, plan)
+            pc_state = "hit" if cached else \
+                ("miss" if self.plan_cache.enabled else "off")
+            sp.tag(plan_cache=pc_state)
         t1 = _time.perf_counter()
-        ex = engine.materialize(plan)
+        self.inflight.stage(entry, "plan")
+        with obs_trace.span("plan"):
+            ex = engine.materialize(plan)
         t2 = _time.perf_counter()
-        res = ex.execute()
+        self.inflight.stage(entry, "execute")
+        with obs_trace.span("execute", plan=type(ex).__name__):
+            res = ex.execute()
         t3 = _time.perf_counter()
+        stages["parseMs"] = round((t1 - t0) * 1000, 3)
+        stages["planMs"] = round((t2 - t1) * 1000, 3)
+        stages["execMs"] = round((t3 - t2) * 1000, 3)
+        stages["planCache"] = pc_state
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
         hist_wire = bool(self._param(qs, "hist-wire"))
         stats_json = self._query_stats(engine, res)
         stats_json["timings"] = {
-            "parseMs": round((t1 - t0) * 1000, 3),
-            "planMs": round((t2 - t1) * 1000, 3),
-            "execMs": round((t3 - t2) * 1000, 3),
+            "parseMs": stages["parseMs"],
+            "planMs": stages["planMs"],
+            "execMs": stages["execMs"],
             "plan": type(ex).__name__,
-            "planCache": "hit" if cached else
-                         ("miss" if self.plan_cache.enabled else "off"),
+            "planCache": pc_state,
         }
+        self.inflight.stage(entry, "encode")
         if isinstance(res, GridResult) and not hist_wire \
-                and not res.is_hist():
+                and not res.is_hist() and not force_dict:
             # serving fast path: bulk matrix rows encode straight to
             # JSON bytes (memoized ts/value fragments), skipping the
-            # dict tree + json.dumps walk
+            # dict tree + json.dumps walk. Traced requests take the
+            # dict path below so spans can ride the envelope —
+            # untraced responses stay byte-identical.
             st = engine.stats
             warnings = list(getattr(st, "warnings", ()) or ())
             warnings.extend(res.warnings)
             partial = bool(getattr(st, "partial", False) or res.partial)
-            return 200, prom_json.matrix_bytes(
+            out = prom_json.matrix_bytes(
                 res, stats_json, warnings=warnings, partial=partial)
-        out = prom_json.matrix(res, hist_wire=hist_wire)
-        out["stats"] = stats_json
-        prom_json.attach_degraded(out, res, engine.stats)
+            stages["encodeMs"] = round(
+                (_time.perf_counter() - t3) * 1000, 3)
+            return 200, out
+        with obs_trace.span("encode"):
+            out = prom_json.matrix(res, hist_wire=hist_wire)
+            out["stats"] = stats_json
+            prom_json.attach_degraded(out, res, engine.stats)
+        stages["encodeMs"] = round((_time.perf_counter() - t3) * 1000, 3)
         return 200, out
 
-    def _query_instant(self, engine, qs, ds: str = "timeseries"):
+    def _maybe_slow_log(self, total_s: float, query: str, ds: str,
+                        kind: str, engine, stages: Dict, tr) -> None:
+        """Build + record the structured slow-query record (only on the
+        slow path — fast queries pay one float compare)."""
+        if not self.slow_log.enabled \
+                or total_s * 1000 < self.slow_log.threshold_ms:
+            return
+        st = getattr(engine, "stats", None)
+        rec = {
+            "query": query, "dataset": ds, "kind": kind,
+            "stages": dict(stages),
+            "shards": sorted(
+                int(n) for s in getattr(engine, "shards", ())
+                for n in (s.shard_num if isinstance(
+                    getattr(s, "shard_num", None), tuple)
+                    else (getattr(s, "shard_num", -1),))),
+            "seriesScanned": getattr(st, "series_scanned", 0),
+            "samplesScanned": getattr(st, "samples_scanned", 0),
+            "partial": bool(getattr(st, "partial", False)),
+            "warnings": list(getattr(st, "warnings", ()) or ()),
+        }
+        if tr is not None:
+            rec["trace_id"] = tr.trace_id
+        self.slow_log.maybe_record(total_s * 1000, rec)
+
+    def _query_instant(self, engine, qs, ds: str = "timeseries",
+                       tctx=None):
+        import time as _time
         query = self._param(qs, "query")
         if not query:
             raise QueryError("missing query parameter")
         time_s = int(float(self._param(qs, "time", "0")))
+        explain_trace = self._param(qs, "explain") == "trace"
+        tr = self.tracer.start(tctx, force=explain_trace)
+        entry = self.inflight.register(
+            query, ds, kind="instant",
+            trace_id=tr.trace_id if tr is not None else None)
+        stages: Dict[str, object] = {}
+        t0 = _time.perf_counter()
+        try:
+            with obs_trace.activate(tr):
+                with obs_trace.span("query", query=query, dataset=ds,
+                                    node=self.node_id or ""):
+                    code, payload = self._query_instant_stages(
+                        engine, qs, ds, query, time_s, entry, stages)
+            if tr is not None and isinstance(payload, dict):
+                if tctx is not None:
+                    payload["trace_spans"] = tr.spans_json()
+                else:
+                    self.tracer.finish(tr)
+                    if explain_trace:
+                        payload["trace"] = tr.to_json()
+            elif tr is not None and tctx is None:
+                self.tracer.finish(tr)
+            return code, payload
+        finally:
+            total_s = _time.perf_counter() - t0
+            self.inflight.unregister(entry)
+            obs_metrics.observe("filodb_query_latency_seconds",
+                                _QLAT_HELP, total_s)
+            self._maybe_slow_log(total_s, query, ds, "instant", engine,
+                                 stages, tr)
+
+    def _query_instant_stages(self, engine, qs, ds, query, time_s,
+                              entry, stages):
+        import time as _time
+        t0 = _time.perf_counter()
+        self.inflight.stage(entry, "parse")
         # instant queries cache under step=0 (start == end == time)
-        plan = self.plan_cache.lookup(ds, query, time_s * 1000, 0,
-                                      time_s * 1000)
-        if plan is None:
-            plan = parse_query(query, time_s)
-            self.plan_cache.store(ds, query, time_s * 1000, 0,
-                                  time_s * 1000, plan)
-        res = engine.execute(plan)
+        with obs_trace.span("parse"):
+            plan = self.plan_cache.lookup(ds, query, time_s * 1000, 0,
+                                          time_s * 1000)
+            if plan is None:
+                plan = parse_query(query, time_s)
+                self.plan_cache.store(ds, query, time_s * 1000, 0,
+                                      time_s * 1000, plan)
+        t1 = _time.perf_counter()
+        self.inflight.stage(entry, "execute")
+        with obs_trace.span("execute"):
+            res = engine.execute(plan)
+        t2 = _time.perf_counter()
+        stages["parseMs"] = round((t1 - t0) * 1000, 3)
+        stages["execMs"] = round((t2 - t1) * 1000, 3)
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=True)
-        out = prom_json.vector(res)
-        out["stats"] = self._query_stats(engine, res)
-        prom_json.attach_degraded(out, res, engine.stats)
+        self.inflight.stage(entry, "encode")
+        with obs_trace.span("encode"):
+            out = prom_json.vector(res)
+            out["stats"] = self._query_stats(engine, res)
+            prom_json.attach_degraded(out, res, engine.stats)
+        stages["encodeMs"] = round((_time.perf_counter() - t2) * 1000, 3)
         return 200, out
+
+    def _debug_traces(self, qs):
+        """GET /debug/traces: recent finished traces (summaries), or one
+        full trace via ?id=<trace_id>."""
+        tid = self._param(qs, "id")
+        if tid:
+            tr = self.tracer.get(tid)
+            if tr is None:
+                return {"status": "error", "errorType": "not_found",
+                        "error": f"no trace {tid} in the ring buffer"}
+            return {"status": "success", "data": tr.to_json()}
+        limit = int(self._param(qs, "limit", "50") or 50)
+        full = (self._param(qs, "full", "") or "").lower() in \
+            ("true", "1", "yes")
+        traces = self.tracer.recent(limit)
+        if full:
+            data = [t.to_json() for t in traces]
+        else:
+            data = [{"trace_id": t.to_json()["trace_id"],
+                     "num_spans": t.to_json()["num_spans"],
+                     "duration_us": t.to_json()["duration_us"]}
+                    for t in traces]
+        return {"status": "success",
+                "summary": self.tracer.snapshot(), "data": data}
 
     @staticmethod
     def _query_stats(engine, res) -> Dict:
@@ -579,22 +778,76 @@ class FiloHttpServer:
                       for i in range(self.shard_mapper.num_shards)]
         return prom_json.success(states)
 
+    # HELP text per family (fallback: a generic string). Kept verbose —
+    # operators read this off the exposition, not the source.
+    _METRIC_HELP = {
+        "filodb_shard_status": "Shard FSM status (1 per shard; labels "
+                               "carry status/node)",
+        "filodb_cardinality_total_series": "Total series tracked by the "
+                                           "shard's cardinality tracker",
+        "filodb_cardinality_active_series": "Actively-ingesting series",
+        "filodb_tile_cache_entries": "Device tile-cache entries",
+        "filodb_tile_builds_total": "Device tile (re)builds",
+        "filodb_tile_cache_hits_total": "Device tile-cache hits",
+        "filodb_exec_cache_hits_total": "Compiled-executable reuse hits",
+        "filodb_exec_cache_misses_total": "Compiled-executable retraces",
+        "filodb_exec_cache_entries": "Distinct compiled kernel shapes",
+        "filodb_batcher_enabled": "Micro-batcher admission on/off",
+        "filodb_batcher_batches_total": "Device dispatches issued",
+        "filodb_batcher_queries_total": "Queries admitted",
+        "filodb_batcher_batched_queries_total":
+            "Queries that shared a batch (size >= 2)",
+        "filodb_batcher_occupancy_avg": "Mean batch size",
+        "filodb_batcher_occupancy_max": "Max batch size seen",
+        "filodb_batcher_gather_wait_ms_total":
+            "Total residual gather-window wait",
+        "filodb_plan_cache_entries": "Parsed-plan LRU entries",
+        "filodb_plan_cache_hits_total": "Plan-cache hits",
+        "filodb_plan_cache_misses_total": "Plan-cache misses",
+        "filodb_plan_cache_rebases_total":
+            "Cached plans rebased onto a new range",
+        "filodb_plan_cache_invalidations_total":
+            "Topology/schema invalidations",
+        "filodb_grpc_rpcs_served_total": "gRPC query-service RPCs served",
+        "filodb_breaker_state": "Per-peer circuit-breaker state "
+                                "(1 per peer; state label)",
+        "filodb_tenant_time_series_total": "Per-tenant series count",
+        "filodb_tenant_time_series_active":
+            "Per-tenant actively-ingesting series count",
+        "filodb_tenant_metering_interval_seconds":
+            "Configured tenant-metering snapshot interval",
+        "filodb_tenant_metering_last_snapshot_age_seconds":
+            "Seconds since the last tenant-metering snapshot",
+        "filodb_tenant_metering_snapshots_total":
+            "Tenant-metering snapshots taken",
+        "filodb_traces_started_total": "Traces started on this node",
+        "filodb_traces_stored": "Finished traces in /debug/traces",
+        "filodb_slow_queries_total": "Queries over the slow-query "
+                                     "threshold",
+        "filodb_inflight_queries": "Queries currently executing",
+    }
+
     def _metrics_text(self) -> str:
-        """Prometheus exposition of shard/query/cache gauges — the
-        Kamon-metrics surface (TimeSeriesShardStats, TimeSeriesShard.scala:41;
-        MemoryStats; ChunkSourceStats; kamon prometheus reporter in
-        filodb-defaults.conf:1016)."""
+        """Prometheus exposition — the Kamon-metrics surface
+        (TimeSeriesShardStats, TimeSeriesShard.scala:41; MemoryStats;
+        ChunkSourceStats; kamon prometheus reporter in
+        filodb-defaults.conf:1016), emitted through
+        :class:`~filodb_tpu.obs.metrics.ExpositionBuilder`: one
+        ``# HELP``/``# TYPE`` block per family, consistent label-value
+        escaping, no duplicate series, and the obs histogram families
+        (query latency, batcher queue wait, device execute, flush,
+        ingest append/fsync) with ``_bucket``/``_sum``/``_count``."""
         import dataclasses as _dc
-        lines: List[str] = []
 
-        def esc(v):
-            # Prometheus text-format label escaping: \ " and newline
-            return str(v).replace("\\", "\\\\").replace('"', '\\"') \
-                .replace("\n", "\\n")
+        b = obs_metrics.ExpositionBuilder()
 
-        def emit(name, labels, value):
-            lbl = ",".join(f'{k}="{esc(v)}"' for k, v in labels.items())
-            lines.append(f"filodb_{name}{{{lbl}}} {value}")
+        def emit(name, labels, value, mtype=None):
+            fam = f"filodb_{name}"
+            if mtype is None:
+                mtype = "counter" if fam.endswith("_total") else "gauge"
+            b.sample(fam, labels, value, mtype=mtype,
+                     help=self._METRIC_HELP.get(
+                         fam, f"FiloDB metric {fam}"))
 
         for ds, shards in self.shards_by_dataset.items():
             for shard in shards:
@@ -653,6 +906,10 @@ class FiloHttpServer:
         emit("plan_cache_misses_total", {}, pc["misses"])
         emit("plan_cache_rebases_total", {}, pc["rebases"])
         emit("plan_cache_invalidations_total", {}, pc["invalidations"])
+        for reason, n in sorted(
+                pc.get("invalidations_by_reason", {}).items()):
+            emit("plan_cache_invalidations_by_reason_total",
+                 {"reason": reason}, n)
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
@@ -680,7 +937,28 @@ class FiloHttpServer:
                           "_ns_": prefix[1] if len(prefix) > 1 else ""}
                 emit("tenant_time_series_total", labels, total)
                 emit("tenant_time_series_active", labels, active)
-        return "\n".join(lines) + "\n"
+            # metering-loop liveness: a stalled/dead snapshot thread
+            # shows as a growing last-snapshot age
+            emit("tenant_metering_interval_seconds", {},
+                 meter.interval_s)
+            age = meter.last_snapshot_age_s
+            if age is not None:
+                emit("tenant_metering_last_snapshot_age_seconds", {},
+                     round(age, 3))
+            emit("tenant_metering_snapshots_total", {}, meter.snapshots)
+        # observability surfaces: tracer + slow-query-log + in-flight
+        ts = self.tracer.snapshot()
+        emit("traces_started_total", {}, ts["started"])
+        emit("traces_stored", {}, ts["stored"])
+        emit("slow_queries_total", {}, self.slow_log.snapshot()["recorded"])
+        emit("inflight_queries", {}, len(self.inflight))
+        # stage-latency histograms (obs.metrics global registry):
+        # query latency, batcher queue wait / batch size, device
+        # execute, flush, ingest append + fsync
+        for h in sorted(obs_metrics.GLOBAL_REGISTRY.histograms(),
+                        key=lambda h: h.name):
+            b.histogram(h)
+        return b.render()
 
     def _cardinality(self, ds: str, qs: Dict, local: bool = False):
         """GET /api/v1/cardinality/{ds}?prefix=ws,ns&depth=N — per-prefix
@@ -719,10 +997,13 @@ class FiloHttpServer:
         return [p["data"] for p in self._fanout(targets)]
 
     # -- cluster plane ----------------------------------------------------
-    def _raw_dispatch(self, ds: str, body: Optional[Dict]):
+    def _raw_dispatch(self, ds: str, body: Optional[Dict], tctx=None):
         """POST /api/v1/raw/{ds}: the leaf-dispatch endpoint peers call to
         read raw series from THIS node's shards (PlanDispatcher.scala:21 —
-        the entry node evaluates the plan over the merged series)."""
+        the entry node evaluates the plan over the merged series).
+        ``tctx`` is the caller's propagated trace context: spans
+        recorded here ride back in ``trace_spans`` for the entry node
+        to stitch."""
         from filodb_tpu.parallel.cluster import (series_to_wire,
                                                  wire_to_filters)
         from filodb_tpu.query.model import QueryStats
@@ -738,15 +1019,23 @@ class FiloHttpServer:
                     min(float(body["timeout_s"]), self.query_timeout_s))
             except (TypeError, ValueError):
                 deadline = None
-        series = self.leaf_select(
-            ds, wire_to_filters(body.get("filters", [])),
-            int(body["start_ms"]), int(body["end_ms"]),
-            body.get("column"), body.get("shards"),
-            span_snap=bool(body.get("full", True)), stats=QueryStats(),
-            deadline=deadline)
+        tr = self.tracer.start(tctx) if tctx is not None else None
+        with obs_trace.activate(tr):
+            with obs_trace.span("peer-fetch-raw",
+                                node=self.node_id or "", dataset=ds,
+                                plane="http"):
+                series = self.leaf_select(
+                    ds, wire_to_filters(body.get("filters", [])),
+                    int(body["start_ms"]), int(body["end_ms"]),
+                    body.get("column"), body.get("shards"),
+                    span_snap=bool(body.get("full", True)),
+                    stats=QueryStats(), deadline=deadline)
         if series is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
-        return 200, {"status": "success", "data": series_to_wire(series)}
+        out = {"status": "success", "data": series_to_wire(series)}
+        if tr is not None:
+            out["trace_spans"] = tr.spans_json()
+        return 200, out
 
     def leaf_select(self, ds: str, filters, start_ms: int, end_ms: int,
                     column, want_shards, span_snap: bool = True,

@@ -32,8 +32,12 @@ from typing import Callable, List, Optional
 
 from filodb_tpu.core.memstore import TimeSeriesShard
 from filodb_tpu.ingest.stream import IngestionStream
+from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
 from filodb_tpu.testing import chaos
+
+_FLUSH_HELP = ("Wall seconds per flush-group persist (encode + "
+               "ColumnStore write + checkpoint)")
 
 
 class IngestionDriver:
@@ -154,7 +158,8 @@ class IngestionDriver:
         # chaos fault point: a failing flush (ColumnStore write error)
         chaos.fire("ingest.flush", shard=self.shard.shard_num,
                    group=group)
-        self.shard.flush_group(group, offset=self.next_offset - 1)
+        with obs_metrics.timed("filodb_flush_seconds", _FLUSH_HELP):
+            self.shard.flush_group(group, offset=self.next_offset - 1)
         if self.max_resident_samples:
             self.shard.ensure_headroom(self.max_resident_samples)
         self._records_since_flush = 0

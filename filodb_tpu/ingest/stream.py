@@ -37,6 +37,13 @@ from filodb_tpu.core.record import PartKey, RecordContainer
 from filodb_tpu.core.schemas import ColumnType, Schemas
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.memory.histogram import _decode_scheme, _encode_scheme
+from filodb_tpu.obs import metrics as obs_metrics
+
+_APPEND_HELP = ("Wall seconds per durable-stream append (encode + "
+                "write + flush + any fsync this append performed)")
+_FSYNC_HELP = ("Wall seconds per durable-stream os.fsync (group commit "
+               "coalesces appends: fsync count / append count is the "
+               "coalescing ratio)")
 
 _REC_MAGIC = 0xF10D
 # record header: magic u16, schema_name_len u16, nrows u32, payload_len u32
@@ -179,24 +186,47 @@ def decode_container(buf: bytes, off: int, schemas: Schemas
 # producer and consumer sides may be different THREADS in one process
 # (embedded gateway + ingest driver): the writer handle, the record
 # position index, and the valid-prefix watermark all ride one lock
-@guarded_by("_lock", "_write_f", "_positions", "_valid_end")
+@guarded_by("_lock", "_write_f", "_positions", "_valid_end",
+            "_last_sync_t", "_unsynced_bytes")
 class LogIngestionStream(IngestionStream):
     """Durable file-backed stream: one append-only framed log per shard —
     the Kafka-partition analogue (1 shard <-> 1 log, KafkaIngestionStream).
 
     Producer side uses ``append``; consumer side polls ``read``.  The two
     may be different processes: the reader tails the file, stopping at any
-    incomplete tail record until the writer finishes it."""
+    incomplete tail record until the writer finishes it.
 
-    def __init__(self, path: str, schemas: Schemas):
+    Group-commit fsync: per-append ``os.fsync`` was the residual
+    episodic stall on shared container disks (ROADMAP follow-up — one
+    slow fsync froze the ingest thread mid-batch). With
+    ``group_commit_s > 0`` appends write+flush but fsync only when the
+    time window elapses or ``group_commit_bytes`` accumulate unsynced —
+    the Kafka ``log.flush.interval`` shape. The durability window is
+    bounded by exactly those two knobs; ``sync()`` forces, ``close()``
+    syncs the tail. ``group_commit_s = 0`` (the default) keeps the
+    strict fsync-per-append behavior. Every real fsync observes
+    ``filodb_ingest_fsync_seconds`` so the stall the ROADMAP saw is
+    visible data, not a guess."""
+
+    def __init__(self, path: str, schemas: Schemas,
+                 group_commit_s: float = 0.0,
+                 group_commit_bytes: int = 1 << 20):
         self.path = path
         self.schemas = schemas
+        self.group_commit_s = float(group_commit_s)
+        self.group_commit_bytes = int(group_commit_bytes)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._write_f = None
         self._lock = threading.Lock()
         # reader state: byte positions of each complete record
         self._positions: List[int] = []
         self._valid_end = 0
+        # group-commit state: when the last fsync happened and how many
+        # bytes are flushed-but-unsynced since
+        self._last_sync_t = 0.0
+        self._unsynced_bytes = 0
+        self.appends = 0
+        self.fsyncs = 0
 
     # -- producer side ----------------------------------------------------
     def append(self, container: RecordContainer, fsync: bool = True) -> int:
@@ -204,6 +234,8 @@ class LogIngestionStream(IngestionStream):
         per shard log (the shard<->partition ownership invariant); on
         takeover, a torn tail left by a crashed writer is truncated so the
         new append lands on a record boundary."""
+        import time as _time
+        t0 = _time.perf_counter()
         data = encode_container(container)
         with self._lock:
             if self._write_f is None:
@@ -215,11 +247,43 @@ class LogIngestionStream(IngestionStream):
             off = len(self._positions)
             self._write_f.write(data)
             self._write_f.flush()
+            self._unsynced_bytes += len(data)
             if fsync:
-                os.fsync(self._write_f.fileno())
+                self._maybe_fsync_locked()
             self._positions.append(self._valid_end)
             self._valid_end += len(data)
-            return off
+            self.appends += 1
+        obs_metrics.observe("filodb_ingest_append_seconds", _APPEND_HELP,
+                            _time.perf_counter() - t0,
+                            obs_metrics.FSYNC_BUCKETS_S)
+        return off
+
+    def _maybe_fsync_locked(self, force: bool = False) -> None:
+        """Group commit: fsync now when forced, when group commit is
+        off, or when the time/size bound tripped; otherwise leave the
+        bytes flushed-but-unsynced (the bounded durability window)."""
+        import time as _time
+        if self._unsynced_bytes == 0:
+            return
+        now = _time.monotonic()
+        if not force and self.group_commit_s > 0:
+            if (now - self._last_sync_t < self.group_commit_s
+                    and self._unsynced_bytes < self.group_commit_bytes):
+                return
+        t0 = _time.perf_counter()
+        os.fsync(self._write_f.fileno())
+        obs_metrics.observe("filodb_ingest_fsync_seconds", _FSYNC_HELP,
+                            _time.perf_counter() - t0,
+                            obs_metrics.FSYNC_BUCKETS_S)
+        self.fsyncs += 1
+        self._last_sync_t = now
+        self._unsynced_bytes = 0
+
+    def sync(self) -> None:
+        """Force-fsync any unsynced tail (checkpoint barriers)."""
+        with self._lock:
+            if self._write_f is not None:
+                self._maybe_fsync_locked(force=True)
 
     # -- consumer side ----------------------------------------------------
     def _refresh_locked(self) -> int:
@@ -275,5 +339,8 @@ class LogIngestionStream(IngestionStream):
     def close(self) -> None:
         with self._lock:
             if self._write_f is not None:
+                # sync the group-commit tail: a clean close must not
+                # leave the durability window open
+                self._maybe_fsync_locked(force=True)
                 self._write_f.close()
                 self._write_f = None

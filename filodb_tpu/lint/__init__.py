@@ -249,7 +249,7 @@ def _load_rule_modules() -> None:
         return
     _rule_modules_loaded = True
     from filodb_tpu.lint import (rules_hot, rules_kernel,  # noqa: F401
-                                 rules_lock, rules_trace)
+                                 rules_lock, rules_span, rules_trace)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
@@ -264,7 +264,7 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     ``jax.eval_shape``)."""
     _load_rule_modules()
     from filodb_tpu.lint import (rules_hot, rules_kernel, rules_lock,
-                                 rules_trace)
+                                 rules_span, rules_trace)
     root = package_root()
     if paths is None:
         paths = [os.path.join(root, "filodb_tpu")]
@@ -290,6 +290,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         for f in rules_trace.check_module(mod):
             raw.append((mod, f))
         for f in rules_hot.check_module(mod):
+            raw.append((mod, f))
+        for f in rules_span.check_module(mod):
             raw.append((mod, f))
         for f in rules_lock.check_module(mod, lock_decls):
             raw.append((mod, f))

@@ -1,0 +1,34 @@
+"""Observability spine: distributed tracing, Prometheus histograms,
+and the slow-query / in-flight registry.
+
+The reference system instruments every shard and exec-plan node through
+Kamon (TimeSeriesShardStats, TimeSeriesShard.scala:41; Kamon spans in
+QueryActor) and threads QueryStats through execution. This package is
+the TPU build's equivalent, shaped for the post-PR-3 concurrent serving
+pipeline (plan cache -> micro-batcher -> async device executor ->
+HTTP/gRPC peer fan-out):
+
+  * :mod:`filodb_tpu.obs.trace` — a lightweight span API (context
+    manager, ~zero cost when no trace is active, sampled when enabled)
+    with Dapper-style trace context propagated on both planes (the
+    ``X-Filo-Trace`` HTTP header and dedicated gRPC wire fields), so a
+    cluster query yields ONE stitched trace covering parse ->
+    plan-cache -> select -> pack -> batcher-queue-wait ->
+    device-dispatch -> device-sync -> remote-peer subspans (including
+    retry attempts and breaker rejections) -> JSON encode.
+  * :mod:`filodb_tpu.obs.metrics` — a fixed-bucket Prometheus histogram
+    primitive (``_bucket``/``_sum``/``_count`` exposition with
+    ``# HELP``/``# TYPE``) replacing point gauges for the stage
+    latencies, so p50/p95/p99 are scrapeable instead of recomputed in
+    bench scripts.
+  * :mod:`filodb_tpu.obs.slowlog` — the slow-query log (structured
+    records for queries over a threshold, with a per-stage breakdown)
+    and the in-flight query registry behind ``/debug/queries``.
+"""
+
+from filodb_tpu.obs.metrics import (  # noqa: F401
+    GLOBAL_REGISTRY, Histogram, MetricsRegistry)
+from filodb_tpu.obs.slowlog import (  # noqa: F401
+    InflightRegistry, SlowQueryLog)
+from filodb_tpu.obs.trace import (  # noqa: F401
+    Span, Trace, Tracer, span, trace_active)

@@ -1,0 +1,249 @@
+"""Fixed-bucket Prometheus histograms + the exposition builder.
+
+The Kamon-histogram surface the reference gets for free: stage
+latencies (query total, batcher queue wait, device execute, flush,
+ingest append, fsync) are observed into fixed cumulative buckets and
+exposed as well-formed ``_bucket``/``_sum``/``_count`` families with
+``# HELP``/``# TYPE`` lines, so p50/p95/p99 come out of any Prometheus
+scrape instead of being recomputed client-side in bench scripts.
+
+Also home of :class:`ExpositionBuilder`, the family-grouped text-format
+writer the ``/metrics`` endpoint uses for EVERY family (gauges and
+counters included): one ``# HELP``/``# TYPE`` block per family,
+consistent label-value escaping, and a guaranteed absence of duplicate
+series.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from filodb_tpu.lint.locks import guarded_by
+
+# latency buckets in seconds: sub-ms serving path up to multi-second
+# degraded tails (the Prometheus http duration defaults, extended down)
+LATENCY_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+# fsync/append: flash-to-spinning-rust-to-stalled-container spread
+FSYNC_BUCKETS_S = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+# batch occupancy: powers of two up to the batcher's max_batch scale
+OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+
+
+def _fmt_float(v: float) -> str:
+    """Prometheus sample-value text: integral floats print bare."""
+    if v == math.inf:
+        return "+Inf"
+    if v == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+@guarded_by("_lock", "_counts", "_sum", "_count")
+class Histogram:
+    """One cumulative fixed-bucket histogram (thread-safe observe)."""
+
+    def __init__(self, name: str, help: str,
+                 buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        self.name = name
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets must be sorted/unique: {buckets}")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self._counts)
+            return {"buckets": self.buckets, "counts": counts,
+                    "sum": self._sum, "count": self._count}
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile (what a PromQL
+        histogram_quantile would compute); NaN when empty."""
+        snap = self.snapshot()
+        total = snap["count"]
+        if total == 0:
+            return math.nan
+        rank = q * total
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(snap["counts"]):
+            prev = cum
+            cum += c
+            if cum >= rank:
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else self.buckets[-1])
+                if i >= len(self.buckets):
+                    return float(self.buckets[-1])
+                frac = (rank - prev) / c if c else 0.0
+                return lo + (hi - lo) * frac
+            lo = self.buckets[i] if i < len(self.buckets) else lo
+        return float(self.buckets[-1])
+
+
+class MetricsRegistry:
+    """Name-keyed histogram registry. One process-global instance
+    (:data:`GLOBAL_REGISTRY`) serves the deep layers (batcher, ingest
+    stream, device dispatch) that have no natural path to the server
+    object; the /metrics endpoint exposes it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: Dict[str, Histogram] = {}
+
+    def histogram(self, name: str, help: str,
+                  buckets: Sequence[float] = LATENCY_BUCKETS_S
+                  ) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = Histogram(name, help, buckets)
+                self._hists[name] = h
+            return h
+
+    def get(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def histograms(self) -> List[Histogram]:
+        with self._lock:
+            return list(self._hists.values())
+
+    def reset(self) -> None:
+        """Test hook: drop all registered histograms."""
+        with self._lock:
+            self._hists.clear()
+
+
+GLOBAL_REGISTRY = MetricsRegistry()
+
+
+def observe(name: str, help: str, value: float,
+            buckets: Sequence[float] = LATENCY_BUCKETS_S) -> None:
+    """One-line observe into the global registry."""
+    GLOBAL_REGISTRY.histogram(name, help, buckets).observe(value)
+
+
+class timed:
+    """``with metrics.timed("filodb_x_seconds", "help"):`` — observes
+    the elapsed wall seconds into the global registry on exit."""
+
+    __slots__ = ("_name", "_help", "_buckets", "_t0")
+
+    def __init__(self, name: str, help: str,
+                 buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        self._name = name
+        self._help = help
+        self._buckets = buckets
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        observe(self._name, self._help,
+                time.perf_counter() - self._t0, self._buckets)
+        return False
+
+
+# -- exposition --------------------------------------------------------------
+
+def escape_label(v: object) -> str:
+    """Prometheus text-format label-value escaping: backslash, quote,
+    newline (the one escaping rule, applied to EVERY label value)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def escape_help(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class ExpositionBuilder:
+    """Family-grouped Prometheus text-format writer.
+
+    Samples accumulate per family; ``render()`` emits one
+    ``# HELP``/``# TYPE`` block per family followed by its samples,
+    with duplicate series (same name + label set) dropped
+    deterministically (first writer wins) so the exposition always
+    parses."""
+
+    def __init__(self):
+        # family -> (type, help, [(labels_tuple, value_str)])
+        self._families: "Dict[str, Tuple[str, str, List]]" = {}
+        self._order: List[str] = []
+
+    def declare(self, name: str, mtype: str, help: str) -> None:
+        if name not in self._families:
+            self._families[name] = (mtype, help, [])
+            self._order.append(name)
+
+    def sample(self, name: str, labels: Dict[str, object], value,
+               mtype: str = "gauge", help: str = "",
+               family: Optional[str] = None) -> None:
+        """Add one sample. ``family`` overrides the HELP/TYPE grouping
+        key for histogram children (``x_bucket`` groups under ``x``)."""
+        fam = family or name
+        if fam not in self._families:
+            self.declare(fam, mtype,
+                         help or f"FiloDB metric {fam}")
+        self._families[fam][2].append(
+            (name, tuple(sorted((str(k), str(v))
+                                for k, v in labels.items())), value))
+
+    def histogram(self, h: Histogram,
+                  labels: Optional[Dict[str, object]] = None) -> None:
+        labels = labels or {}
+        snap = h.snapshot()
+        self.declare(h.name, "histogram", h.help)
+        cum = 0
+        for b, c in zip(snap["buckets"], snap["counts"]):
+            cum += c
+            self.sample(h.name + "_bucket",
+                        {**labels, "le": _fmt_float(b)}, cum,
+                        family=h.name)
+        cum += snap["counts"][-1]
+        self.sample(h.name + "_bucket", {**labels, "le": "+Inf"}, cum,
+                    family=h.name)
+        self.sample(h.name + "_sum", labels, snap["sum"],
+                    family=h.name)
+        self.sample(h.name + "_count", labels, snap["count"],
+                    family=h.name)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        seen: set = set()
+        for fam in self._order:
+            mtype, help, samples = self._families[fam]
+            if not samples:
+                continue
+            lines.append(f"# HELP {fam} {escape_help(help)}")
+            lines.append(f"# TYPE {fam} {mtype}")
+            for name, labels, value in samples:
+                key = (name, labels)
+                if key in seen:
+                    continue        # no duplicate series, ever
+                seen.add(key)
+                if labels:
+                    lbl = ",".join(f'{k}="{escape_label(v)}"'
+                                   for k, v in labels)
+                    lines.append(f"{name}{{{lbl}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,416 @@
+"""Lightweight distributed tracing for the serving pipeline.
+
+Dapper-style propagated trace context: an entry node starts a trace
+(sampled), every stage opens spans through the :func:`span` context
+manager, and remote hops forward ``trace_id`` + the parent span id on
+the wire (HTTP: the ``X-Filo-Trace`` header; gRPC: dedicated fields in
+RawRequest/ExecRequest). The PEER records its spans locally and ships
+them back in the response envelope, so the entry node's recorder holds
+one stitched trace covering every hop — the standard tool for
+attributing tail latency in a fan-out system.
+
+Design constraints:
+
+  * ~zero cost when no trace is active: ``span()`` reads one
+    thread-local attribute and returns a shared no-op context manager.
+    No allocation, no clock read, no string formatting happens on the
+    untraced path — disabled-tracing responses stay byte-identical and
+    the bench overhead stays within noise.
+  * spans may be recorded from multiple threads (HTTP workers, the
+    batcher's device-executor thread): the active trace is carried in a
+    thread-local and can be captured/reinstalled across thread hops
+    (:func:`capture` / :func:`use` — the micro-batcher does this for
+    closures it runs on the executor thread).
+  * bounded memory: a trace stops recording past ``MAX_SPANS`` (a
+    runaway fan-out can't balloon the ring buffer), and the
+    :class:`Tracer`'s recorder keeps the last N finished traces.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+# spans per trace cap: a 256-shard fan-out with retries stays well under
+# this; anything bigger is a runaway and gets truncated (tagged).
+MAX_SPANS = 512
+
+_ids = itertools.count(1)
+_state = threading.local()
+
+
+def _new_id() -> str:
+    # 64-bit random hex; cheap, collision-safe at ring-buffer scale
+    return f"{random.getrandbits(64):016x}"
+
+
+class Span:
+    """One timed operation inside a trace. Created via :func:`span`;
+    mutate tags through ``tag()`` while open."""
+
+    __slots__ = ("name", "span_id", "parent_id", "start_ns", "dur_ns",
+                 "tags", "error")
+
+    def __init__(self, name: str, span_id: str, parent_id: Optional[str],
+                 start_ns: int):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.dur_ns = -1            # -1 = still open
+        self.tags: Dict[str, object] = {}
+        self.error: Optional[str] = None
+
+    def tag(self, **tags) -> "Span":
+        self.tags.update(tags)
+        return self
+
+    def to_json(self) -> Dict:
+        d = {"name": self.name, "span_id": self.span_id,
+             "parent_id": self.parent_id,
+             "start_us": self.start_ns // 1000,
+             "dur_us": self.dur_ns // 1000 if self.dur_ns >= 0 else -1}
+        if self.tags:
+            d["tags"] = dict(self.tags)
+        if self.error:
+            d["error"] = self.error
+        return d
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "Span":
+        s = cls(d.get("name", "?"), d.get("span_id", "?"),
+                d.get("parent_id"), int(d.get("start_us", 0)) * 1000)
+        dur = int(d.get("dur_us", -1))
+        s.dur_ns = dur * 1000 if dur >= 0 else -1
+        s.tags = dict(d.get("tags") or {})
+        s.error = d.get("error")
+        return s
+
+
+class Trace:
+    """One trace being recorded on THIS node (entry node or a peer
+    serving a propagated context). Span appends are lock-protected —
+    HTTP workers and the device executor both record."""
+
+    __slots__ = ("trace_id", "node", "spans", "truncated", "_lock",
+                 "root_parent")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 node: str = "", root_parent: Optional[str] = None):
+        self.trace_id = trace_id or _new_id()
+        self.node = node
+        # parent span id carried in from the caller (peer hop); local
+        # root spans attach under it so the entry node stitches cleanly
+        self.root_parent = root_parent
+        self.spans: List[Span] = []
+        self.truncated = False
+        self._lock = threading.Lock()
+
+    def add(self, sp: Span) -> None:
+        with self._lock:
+            if len(self.spans) >= MAX_SPANS:
+                self.truncated = True
+                return
+            self.spans.append(sp)
+
+    def absorb(self, spans_json: List[Dict]) -> None:
+        """Fold a peer's serialized spans into this trace (the stitch).
+        The peer already parented them under the span id we forwarded."""
+        with self._lock:
+            for d in spans_json:
+                if len(self.spans) >= MAX_SPANS:
+                    self.truncated = True
+                    return
+                self.spans.append(Span.from_json(d))
+
+    def spans_json(self) -> List[Dict]:
+        with self._lock:
+            return [s.to_json() for s in self.spans]
+
+    def to_json(self) -> Dict:
+        spans = self.spans_json()
+        dur = 0
+        for s in spans:
+            if s["parent_id"] is None or s["parent_id"] == \
+                    self.root_parent:
+                dur = max(dur, s["dur_us"])
+        return {"trace_id": self.trace_id, "node": self.node,
+                "num_spans": len(spans), "duration_us": dur,
+                "truncated": self.truncated, "spans": spans}
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the untraced fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def tag(self, **tags):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    """Context manager recording one span into the active trace."""
+
+    __slots__ = ("_trace", "_span", "_prev")
+
+    def __init__(self, trace: Trace, name: str, parent_id: Optional[str],
+                 tags: Dict):
+        self._trace = trace
+        sp = Span(name, _new_id(), parent_id, time.time_ns())
+        if tags:
+            sp.tags.update(tags)
+        self._span = sp
+
+    def __enter__(self) -> Span:
+        self._prev = getattr(_state, "parent", None)
+        _state.parent = self._span.span_id
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        sp = self._span
+        sp.dur_ns = time.time_ns() - sp.start_ns
+        if exc is not None and sp.error is None:
+            sp.error = f"{type(exc).__name__}: {exc}"
+        _state.parent = self._prev
+        self._trace.add(sp)
+        return False
+
+
+# -- the thread-local active-trace API ---------------------------------------
+
+def span(name: str, **tags):
+    """Open a span under the thread's active trace; no-op (shared
+    object, no allocation) when no trace is active. Usable from any
+    layer without threading a tracer object through."""
+    tr = getattr(_state, "trace", None)
+    if tr is None:
+        return _NOOP
+    return _LiveSpan(tr, name, getattr(_state, "parent", None), tags)
+
+
+def event(name: str, **tags) -> None:
+    """Zero-duration span (a point annotation, e.g. a breaker
+    rejection); no-op when no trace is active."""
+    tr = getattr(_state, "trace", None)
+    if tr is None:
+        return
+    sp = Span(name, _new_id(), getattr(_state, "parent", None),
+              time.time_ns())
+    sp.dur_ns = 0
+    if tags:
+        sp.tags.update(tags)
+    tr.add(sp)
+
+
+def trace_active() -> bool:
+    return getattr(_state, "trace", None) is not None
+
+
+def current_trace() -> Optional[Trace]:
+    return getattr(_state, "trace", None)
+
+
+def capture() -> Optional[Tuple[Trace, Optional[str]]]:
+    """Snapshot (trace, parent span id) for reinstalling on another
+    thread (the batcher's executor hop); None when untraced."""
+    tr = getattr(_state, "trace", None)
+    if tr is None:
+        return None
+    return tr, getattr(_state, "parent", None)
+
+
+class use:
+    """Reinstall a captured trace context on the current thread:
+    ``with trace.use(ctx): ...``. ``ctx=None`` is a no-op (so callers
+    can pass ``capture()``'s result through unconditionally)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[Tuple[Trace, Optional[str]]]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is None:
+            return self
+        self._prev = (getattr(_state, "trace", None),
+                      getattr(_state, "parent", None))
+        _state.trace = self._ctx[0]
+        _state.parent = self._ctx[1]
+        return self
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            _state.trace, _state.parent = self._prev
+        return False
+
+
+class activate:
+    """Install ``trace`` as the thread's active trace for the scope
+    (the per-request entry point; :class:`Tracer` wraps this)."""
+
+    __slots__ = ("_trace", "_prev")
+
+    def __init__(self, trace: Optional[Trace]):
+        self._trace = trace
+
+    def __enter__(self) -> Optional[Trace]:
+        self._prev = (getattr(_state, "trace", None),
+                      getattr(_state, "parent", None))
+        _state.trace = self._trace
+        _state.parent = self._trace.root_parent \
+            if self._trace is not None else None
+        return self._trace
+
+    def __exit__(self, *exc):
+        _state.trace, _state.parent = self._prev
+        return False
+
+
+# -- wire propagation --------------------------------------------------------
+
+HEADER = "X-Filo-Trace"
+
+
+def inject_header() -> Optional[str]:
+    """``trace_id-parent_span_id-1`` for the active trace (the b3-style
+    single header), or None when untraced."""
+    tr = getattr(_state, "trace", None)
+    if tr is None:
+        return None
+    parent = getattr(_state, "parent", None) or ""
+    return f"{tr.trace_id}-{parent}-1"
+
+
+def parse_context(value: Optional[str]
+                  ) -> Optional[Tuple[str, Optional[str]]]:
+    """Parse a propagated context into (trace_id, parent_span_id);
+    None on absent/malformed input (malformed context must never fail
+    a query)."""
+    if not value:
+        return None
+    parts = str(value).split("-")
+    if len(parts) < 1 or not parts[0]:
+        return None
+    parent = parts[1] if len(parts) > 1 and parts[1] else None
+    return parts[0], parent
+
+
+def spans_wire(trace: Optional[Trace]) -> bytes:
+    """Serialized spans for a response envelope (gRPC field / HTTP
+    JSON); empty when untraced."""
+    if trace is None:
+        return b""
+    return json.dumps(trace.spans_json(),
+                      separators=(",", ":")).encode()
+
+
+def absorb_spans(spans) -> None:
+    """Fold a peer's already-parsed span list (JSON-decoded dicts) into
+    the active trace; no-op when untraced or empty."""
+    tr = getattr(_state, "trace", None)
+    if tr is None or not spans:
+        return
+    try:
+        tr.absorb([d for d in spans if isinstance(d, dict)])
+    except (TypeError, ValueError):
+        pass
+
+
+def absorb_wire(buf) -> None:
+    """Fold a peer's serialized span list into the active trace;
+    tolerant of garbage (a peer's malformed payload must never fail
+    the query)."""
+    tr = getattr(_state, "trace", None)
+    if tr is None or not buf:
+        return
+    try:
+        if isinstance(buf, (bytes, bytearray)):
+            buf = buf.decode()
+        spans = json.loads(buf)
+        if isinstance(spans, list):
+            tr.absorb([d for d in spans if isinstance(d, dict)])
+    except (ValueError, UnicodeDecodeError):
+        pass
+
+
+# -- the per-server tracer ---------------------------------------------------
+
+class Tracer:
+    """Sampling policy + bounded recorder of finished traces.
+
+    One per server process (the HTTP server owns it). ``enabled=False``
+    (the default) never starts traces — ``span()`` stays on the no-op
+    path everywhere. A propagated context from a caller is always
+    honored (the entry node made the sampling decision)."""
+
+    def __init__(self, enabled: bool = False, sample_rate: float = 1.0,
+                 max_traces: int = 256, node: str = ""):
+        self.enabled = bool(enabled)
+        self.sample_rate = float(sample_rate)
+        self.node = node
+        self._lock = threading.Lock()
+        self._max = max(1, int(max_traces))
+        # trace_id -> Trace; insertion-ordered ring (oldest evicted)
+        self._finished: "OrderedDict[str, Trace]" = OrderedDict()
+        self.started = 0
+        self.sampled_out = 0
+
+    def start(self, ctx: Optional[Tuple[str, Optional[str]]] = None,
+              force: bool = False) -> Optional[Trace]:
+        """A Trace for this request, or None (untraced). ``ctx`` is a
+        propagated (trace_id, parent_span_id) from the caller — always
+        honored. Fresh requests sample at ``sample_rate``; ``force``
+        (the ``&explain=trace`` opt-in) bypasses both the enable flag
+        and the sampler for one request."""
+        if ctx is not None:
+            self.started += 1
+            return Trace(ctx[0], node=self.node, root_parent=ctx[1])
+        if not force:
+            if not self.enabled:
+                return None
+            if self.sample_rate < 1.0 \
+                    and random.random() >= self.sample_rate:
+                self.sampled_out += 1
+                return None
+        self.started += 1
+        return Trace(node=self.node)
+
+    def finish(self, trace: Optional[Trace]) -> None:
+        """Record a completed ENTRY-NODE trace in the ring buffer (peer
+        hops ship their spans back instead of recording locally)."""
+        if trace is None:
+            return
+        with self._lock:
+            self._finished[trace.trace_id] = trace
+            self._finished.move_to_end(trace.trace_id)
+            while len(self._finished) > self._max:
+                self._finished.popitem(last=False)
+
+    def get(self, trace_id: str) -> Optional[Trace]:
+        with self._lock:
+            return self._finished.get(trace_id)
+
+    def recent(self, limit: int = 50) -> List[Trace]:
+        with self._lock:
+            out = list(self._finished.values())
+        return out[-max(1, int(limit)):][::-1]
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            stored = len(self._finished)
+        return {"enabled": int(self.enabled), "started": self.started,
+                "sampled_out": self.sampled_out, "stored": stored}

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
@@ -185,15 +186,23 @@ class RemoteShardGroup:
                 msg["timeout_s"] = round(
                     max(self.deadline.remaining(), 1e-3), 3)
             body = json.dumps(msg).encode()
+            headers = {"Content-Type": "application/json"}
+            tb = obs_trace.inject_header()
+            if tb:      # trace propagation on the JSON control plane
+                headers[obs_trace.HEADER] = tb
             req = urllib.request.Request(
                 f"{self.base_url}/api/v1/raw/{self.dataset}", data=body,
-                headers={"Content-Type": "application/json"})
+                headers=headers)
             return _get_json(req, self.node_id, timeout_s)
 
-        payload = resilient_call(
-            dial, key=self.base_url, node_id=self.node_id,
-            timeout_s=self.timeout_s, retry=self.retry,
-            breakers=self.breakers, deadline=self.deadline)
+        with obs_trace.span("remote-peer", node=self.node_id,
+                            plane="http", rpc="raw",
+                            addr=self.base_url):
+            payload = resilient_call(
+                dial, key=self.base_url, node_id=self.node_id,
+                timeout_s=self.timeout_s, retry=self.retry,
+                breakers=self.breakers, deadline=self.deadline)
+            obs_trace.absorb_spans(payload.get("trace_spans"))
         return wire_to_series(payload["data"])
 
     # metadata plans are answered via the HTTP layer's peer fan-out, not
@@ -264,12 +273,20 @@ class PromQlRemoteExec:
                                               1e-3)
             url = (f"{self.base_url}/promql/{self.dataset}/api/v1/"
                    f"{path}?" + urllib.parse.urlencode(qs))
+            tb = obs_trace.inject_header()
+            if tb:      # trace propagation on the HTTP pushdown plane
+                url = urllib.request.Request(
+                    url, headers={obs_trace.HEADER: tb})
             return _get_json(url, self.node_id, t)
 
-        payload = resilient_call(
-            dial, key=self.base_url, node_id=self.node_id,
-            timeout_s=self.timeout_s, retry=self.retry,
-            breakers=self.breakers, deadline=self.deadline)
+        with obs_trace.span("remote-peer", node=self.node_id,
+                            plane="http", rpc="exec",
+                            addr=self.base_url):
+            payload = resilient_call(
+                dial, key=self.base_url, node_id=self.node_id,
+                timeout_s=self.timeout_s, retry=self.retry,
+                breakers=self.breakers, deadline=self.deadline)
+            obs_trace.absorb_spans(payload.get("trace_spans"))
         if self.stats is not None and "stats" in payload:
             self.stats.series_scanned += payload["stats"].get(
                 "seriesScanned", 0)

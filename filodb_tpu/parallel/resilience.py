@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.query.model import QueryError
 
 
@@ -264,6 +265,9 @@ def resilient_call(do_call: Callable[[float], object], *,
     breaker = registry.get(key)
     if not breaker.allow():
         registry.record(key, "rejections")
+        # tracing: a rejected dial is a point event on the trace — the
+        # call never happened, so there is no duration to record
+        obs_trace.event("breaker-rejected", peer=node_id, key=key)
         raise BreakerOpenError(
             f"peer {node_id} ({key}) circuit breaker is open")
     attempt = 0
@@ -275,7 +279,12 @@ def resilient_call(do_call: Callable[[float], object], *,
         t = deadline.clip(timeout_s) if deadline is not None \
             else float(timeout_s)
         try:
-            out = do_call(t)
+            # each attempt is its own span: a retried call shows up in
+            # the trace as SIBLING spans, the failed ones tagged with
+            # the transport error (span __exit__ records it)
+            with obs_trace.span("peer-attempt", peer=node_id,
+                                attempt=attempt, retry=attempt > 1):
+                out = do_call(t)
         except TransportError:
             breaker.record_failure()
             if attempt >= retry.max_attempts or not breaker.allow():

@@ -58,6 +58,13 @@ import numpy as np
 
 from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.obs import trace as obs_trace
+
+_QWAIT_HELP = ("Wall seconds a query spent parked on the micro-batcher "
+               "(executor queueing + residual gather window); 0 for "
+               "inline single-query dispatches")
+_OCC_HELP = "Members per micro-batch dispatch (batch occupancy)"
 
 
 class DeviceExecutor:
@@ -156,6 +163,10 @@ class BatchStats:
             self.occupancy_max = max(self.occupancy_max, size)
             self.gather_wait_ns += wait_ns
             self.by_size[size] = self.by_size.get(size, 0) + 1
+        # occupancy distribution: p50/p95 batch sizes straight off a
+        # /metrics scrape instead of the avg/max point gauges alone
+        obs_metrics.observe("filodb_batcher_batch_size", _OCC_HELP,
+                            float(size), obs_metrics.OCCUPANCY_BUCKETS)
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -238,6 +249,8 @@ class MicroBatcher:
         if not self.enabled:
             res = run_batch([member])
             self.stats.record(1, 0)
+            obs_metrics.observe("filodb_batcher_queue_wait_seconds",
+                                _QWAIT_HELP, 0.0)
             return res.get(0)
         idx = None
         with self._lock:
@@ -257,13 +270,19 @@ class MicroBatcher:
         if not concurrent:
             # lone request: single-query kernel path, inline — no
             # executor hop, no gather window
+            obs_metrics.observe("filodb_batcher_queue_wait_seconds",
+                                _QWAIT_HELP, 0.0)
             return self._execute(key, p, run_batch, queued=False)
         if self.use_executor:
             # leader under concurrency: queue the OPEN batch — arrivals
             # keep joining until the executor picks it up (its busy
-            # time is the gather window), then park on the future
+            # time is the gather window), then park on the future.
+            # The trace context hops threads with the closure so device
+            # spans recorded on the executor land in the same trace.
+            tctx = obs_trace.capture()
             self.executor.submit(
-                lambda: self._execute(key, p, run_batch, queued=True))
+                lambda: self._execute(key, p, run_batch, queued=True,
+                                      tctx=tctx))
             return self._wait(p, 0)
         # CPU: gather by yielding the GIL a few times (concurrent
         # same-shape submitters join during the yields; no fixed sleep
@@ -273,13 +292,22 @@ class MicroBatcher:
             if len(p.members) >= self.max_batch:
                 break
             time.sleep(0)
+        obs_metrics.observe("filodb_batcher_queue_wait_seconds",
+                            _QWAIT_HELP, 0.0)
         return self._execute(key, p, run_batch, queued=False)
 
+    @hot_path
     def _wait(self, p: _Pending, idx: int) -> np.ndarray:
-        return p.future.result().get(idx)
+        t0 = time.perf_counter()
+        with obs_trace.span("batcher-queue-wait"):
+            res = p.future.result()
+        obs_metrics.observe("filodb_batcher_queue_wait_seconds",
+                            _QWAIT_HELP, time.perf_counter() - t0)
+        with obs_trace.span("device-sync"):
+            return res.get(idx)
 
     def _execute(self, key: object, p: _Pending, run_batch,
-                 queued: bool) -> np.ndarray:
+                 queued: bool, tctx=None) -> np.ndarray:
         """Close + run one batch; on the executor thread when
         ``queued`` (leader parks on the future), inline otherwise."""
         wait_ns = 0
@@ -300,7 +328,10 @@ class MicroBatcher:
                 del self._pending[key]
             members = list(p.members)
         try:
-            res = run_batch(members)
+            # reinstall the submitting thread's trace context when this
+            # runs on the executor thread (no-op for tctx=None/inline)
+            with obs_trace.use(tctx):
+                res = run_batch(members)
         except BaseException as e:  # noqa: BLE001 — fail all members
             self.stats.record(len(members), wait_ns)
             p.future.set_exception(e)
@@ -309,4 +340,7 @@ class MicroBatcher:
             return None
         self.stats.record(len(members), wait_ns)
         p.future.set_result(res)
-        return res.get(0) if not queued else None
+        if queued:
+            return None
+        with obs_trace.span("device-sync"):
+            return res.get(0)

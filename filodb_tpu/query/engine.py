@@ -23,6 +23,7 @@ from filodb_tpu.core.index import ColumnFilter
 from filodb_tpu.core.memstore import TimeSeriesShard
 from filodb_tpu.core.schemas import ColumnType
 from filodb_tpu.memory import histogram as bh
+from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.memory.vectors import counter_correction
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query import rangefn as rf
@@ -57,6 +58,15 @@ def select_raw_series(shards: Sequence[TimeSeriesShard],
     decode + buffer tail) and attaches store snapshot keys; the windowing
     path uses this so device tile caches hit across queries — the step grid
     itself restricts the evaluation to the query range."""
+    with obs_trace.span("select-series", shards=len(shards)) as _sp:
+        out = _select_raw_series(shards, filters, start_ms, end_ms,
+                                 column, stats, full, limits, deadline)
+        _sp.tag(series=len(out))
+        return out
+
+
+def _select_raw_series(shards, filters, start_ms, end_ms, column, stats,
+                       full, limits, deadline) -> List[RawSeries]:
     out: List[RawSeries] = []
     for shard in shards:
         if deadline is not None:
@@ -157,6 +167,17 @@ def select_span_series(shards: Sequence[TimeSeriesShard],
     ``chunk_len`` = its immutable in-span prefix, so the entry node's
     device tile cache reuses tiles across identical re-fetches while
     write-buffer tail rows are spliced live."""
+    with obs_trace.span("select-span", shards=len(shards)) as _sp:
+        out = _select_span_series(shards, filters, start_ms, end_ms,
+                                  column, stats, limits, node_id, ds,
+                                  deadline)
+        _sp.tag(series=len(out))
+        return out
+
+
+def _select_span_series(shards, filters, start_ms, end_ms, column,
+                        stats, limits, node_id, ds,
+                        deadline) -> List[RawSeries]:
     out: List[RawSeries] = []
     for shard in shards:
         if deadline is not None:

@@ -40,7 +40,7 @@ def _cacheable(plan) -> bool:
 
 
 @guarded_by("_lock", "_entries", "hits", "misses", "uncacheable",
-            "invalidations", "rebases")
+            "invalidations", "rebases", "invalidations_by_reason")
 class PlanCache:
     """LRU of parsed logical plans, keyed (dataset, query, step_ms)."""
 
@@ -53,6 +53,9 @@ class PlanCache:
         self.misses = 0
         self.uncacheable = 0
         self.invalidations = 0
+        # observability: WHY the cache was cleared (topology vs schema
+        # vs explicit) — a flapping mapper shows as topology churn here
+        self.invalidations_by_reason: Dict[str, int] = {}
         self.rebases = 0
 
     @property
@@ -104,6 +107,9 @@ class PlanCache:
         with self._lock:
             self._entries.clear()
             self.invalidations += 1
+            key = reason or "unspecified"
+            self.invalidations_by_reason[key] = \
+                self.invalidations_by_reason.get(key, 0) + 1
 
     def __len__(self) -> int:
         with self._lock:
@@ -114,4 +120,6 @@ class PlanCache:
             return {"entries": len(self._entries), "hits": self.hits,
                     "misses": self.misses, "rebases": self.rebases,
                     "uncacheable": self.uncacheable,
-                    "invalidations": self.invalidations}
+                    "invalidations": self.invalidations,
+                    "invalidations_by_reason":
+                        dict(self.invalidations_by_reason)}

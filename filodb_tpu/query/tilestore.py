@@ -1052,12 +1052,22 @@ __guarded_by__ = {"_JIT_STATS": "_JIT_STATS_LOCK"}
 
 def _jit_lookup(cache: Dict[Tuple, object], key: Tuple, build) -> object:
     """Dispatch-table lookup with hit/miss accounting; ``build()`` makes
-    the jitted callable on a miss."""
+    the jitted callable on a miss. Miss-side builds observe
+    ``filodb_kernel_build_seconds`` — a retrace storm (shape-bucket
+    churn, cache invalidation) shows up as histogram mass instead of
+    unexplained tail latency."""
     fn = cache.get(key)
     with _JIT_STATS_LOCK:
         _JIT_STATS["hits" if fn is not None else "misses"] += 1
     if fn is None:
-        fn = build()
+        from filodb_tpu.obs import metrics as obs_metrics
+        from filodb_tpu.obs import trace as obs_trace
+        with obs_metrics.timed(
+                "filodb_kernel_build_seconds",
+                "Wall seconds per evaluator build on a dispatch-table "
+                "miss (trace + XLA compile)"), \
+                obs_trace.span("kernel-build"):
+            fn = build()
         cache[key] = fn
     return fn
 

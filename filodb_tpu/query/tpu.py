@@ -43,7 +43,12 @@ import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
+
+_DEV_HELP = ("Wall seconds per device dispatch (kernel submission + "
+             "device compute + the batch's one host sync)")
 
 
 def _sds(shape, dtype):
@@ -594,12 +599,17 @@ class TpuBackend:
         if self.batcher is not None:
             self.batcher.enter()
         try:
-            aligned = self._try_aligned(series, func, steps, params.step_ms,
-                                        window_ms, offset_ms, func_args)
-            if aligned is not None:
-                return GridResult(steps, keys, aligned)
-            out = self._general(series, func, steps, params.step_ms,
-                                window_ms, offset_ms, func_args)
+            with obs_trace.span("device-eval", func=func,
+                                series=len(series)) as _sp:
+                aligned = self._try_aligned(series, func, steps,
+                                            params.step_ms, window_ms,
+                                            offset_ms, func_args)
+                if aligned is not None:
+                    _sp.tag(path="aligned")
+                    return GridResult(steps, keys, aligned)
+                _sp.tag(path="packed")
+                out = self._general(series, func, steps, params.step_ms,
+                                    window_ms, offset_ms, func_args)
         finally:
             if self.batcher is not None:
                 self.batcher.exit()
@@ -622,7 +632,9 @@ class TpuBackend:
         # retention (select full=True for tile caching)
         series = clip_series(series, int(w0s),
                              int(steps[-1] - offset_ms))
-        ts, vals, lens = pack_series(series, drop_nan=(func != "last_sample"))
+        with obs_trace.span("pack", series=len(series)):
+            ts, vals, lens = pack_series(series,
+                                         drop_nan=(func != "last_sample"))
         scalar = float(func_args[0]) if func_args else 0.0
         w_bound = self._window_sample_bound(series, window_ms, ts.shape[1]) \
             if func in _GATHER_FUNCS else 0
@@ -637,8 +649,12 @@ class TpuBackend:
                                    int(step), nsteps, w_bound)
             return b.submit(key, member, functools.partial(
                 self._packed_run, func, t_bucket, scalar))
-        return self._packed_single(func, ts, vals, lens, w0s, w0e, step,
-                                   nsteps, t_bucket, scalar, w_bound)
+        with obs_metrics.timed("filodb_device_execute_seconds",
+                               _DEV_HELP), \
+                obs_trace.span("device-dispatch", path="packed"):
+            return self._packed_single(func, ts, vals, lens, w0s, w0e,
+                                       step, nsteps, t_bucket, scalar,
+                                       w_bound)
 
     @hot_path
     def _packed_single(self, func, ts, vals, lens, w0s, w0e, step, nsteps,
@@ -680,6 +696,15 @@ class TpuBackend:
         path (bit-for-bit identical; the parity test pins it)."""
         from filodb_tpu.query.batcher import SplitResult
 
+        with obs_metrics.timed("filodb_device_execute_seconds",
+                               _DEV_HELP), \
+                obs_trace.span("device-dispatch", path="packed",
+                               batch=len(members)):
+            return self._packed_run_inner(func, t_bucket, scalar,
+                                          members, SplitResult)
+
+    def _packed_run_inner(self, func: str, t_bucket: int, scalar: float,
+                          members, SplitResult) -> object:
         if len(members) == 1:
             m = members[0]
             out = self._packed_single(func, m.ts, m.vals, m.lens,
@@ -931,18 +956,22 @@ class TpuBackend:
                 functools.partial(self._aligned_run, tiles, func,
                                   family, nsteps, step, window_ms,
                                   offset_ms))
-        if counters:
-            # counter family rides the slot-major f32-hybrid fast path:
-            # int32 timestamps + exact f64 boundary deltas, f32
-            # extrapolation epilogue (~3e-7 relative vs the f64 oracle;
-            # grids wider than int32 ms take the exact path) —
-            # test_tilestore pins parity + the exact fallback
+        with obs_metrics.timed("filodb_device_execute_seconds",
+                               _DEV_HELP), \
+                obs_trace.span("device-dispatch", path="aligned"):
+            if counters:
+                # counter family rides the slot-major f32-hybrid fast
+                # path: int32 timestamps + exact f64 boundary deltas,
+                # f32 extrapolation epilogue (~3e-7 relative vs the f64
+                # oracle; grids wider than int32 ms take the exact
+                # path) — test_tilestore pins parity + the exact
+                # fallback
+                # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+                return np.asarray(tst.evaluate_counters_t(
+                    tiles, func, steps, window_ms, offset_ms).T)
             # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
-            return np.asarray(tst.evaluate_counters_t(
-                tiles, func, steps, window_ms, offset_ms).T)
-        # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
-        return np.asarray(tst.evaluate_aligned(
-            tiles, func, steps, window_ms, offset_ms, func_args))
+            return np.asarray(tst.evaluate_aligned(
+                tiles, func, steps, window_ms, offset_ms, func_args))
 
     def _aligned_run(self, tiles, func: str, family, nsteps: int,
                      step: int, window_ms: int, offset_ms: int,
@@ -952,6 +981,18 @@ class TpuBackend:
         from filodb_tpu.query import tilestore as tst
         from filodb_tpu.query.batcher import SplitResult
 
+        with obs_metrics.timed("filodb_device_execute_seconds",
+                               _DEV_HELP), \
+                obs_trace.span("device-dispatch", path="aligned",
+                               batch=len(members)):
+            return self._aligned_run_inner(tst, SplitResult, tiles,
+                                           func, family, nsteps, step,
+                                           window_ms, offset_ms, members)
+
+    def _aligned_run_inner(self, tst, SplitResult, tiles, func: str,
+                           family, nsteps: int, step: int,
+                           window_ms: int, offset_ms: int,
+                           members) -> object:
         counters = func in ("rate", "increase", "delta")
         if len(members) == 1:
             steps0 = members[0][2]

@@ -83,6 +83,22 @@ DEFAULTS = {
     "batch-max": 8,
     "batch-enabled": True,
     "plan-cache-size": 256,
+    # observability (filodb_tpu.obs): distributed tracing is OFF by
+    # default (zero overhead, byte-identical responses); when enabled,
+    # fresh queries sample at trace-sample-rate and finished traces land
+    # in the /debug/traces ring (&explain=trace forces + inlines one).
+    # Queries slower than slow-query-ms leave a structured record at
+    # /debug/slow_queries (0 = off); /debug/queries lists in-flight.
+    "trace-enabled": False,
+    "trace-sample-rate": 1.0,
+    "trace-max-traces": 256,
+    "slow-query-ms": 1000.0,
+    # group-commit fsync for the durable ingest streams (ROADMAP
+    # follow-up: per-append fsync stalls on shared container disks).
+    # Appends fsync at most every this-many ms (or 1MB unsynced);
+    # 0 = strict fsync-per-append. The durability window is bounded by
+    # this knob; stream close / checkpoint sync() force the tail out.
+    "stream-group-commit-ms": 5.0,
     # admission control: query endpoints admit at most this many
     # in-flight evaluations (excess parks on a semaphore); 0 = off
     "max-inflight-queries": 4,
@@ -174,6 +190,14 @@ class FiloServer:
         self._adopted_drivers: Dict[int, object] = {}
         self._original_shards: Dict[str, list] = {}
         self._gw_streams: Dict[int, object] = {}
+
+    def _make_tracer(self):
+        from filodb_tpu.obs.trace import Tracer
+        return Tracer(
+            enabled=bool(self.config.get("trace-enabled", False)),
+            sample_rate=float(self.config.get("trace-sample-rate", 1.0)),
+            max_traces=int(self.config.get("trace-max-traces", 256)),
+            node=self.node_id)
 
     def _make_shard(self, shard: int):
         """One shard's full construction — tracker with quota overrides,
@@ -347,7 +371,10 @@ class FiloServer:
             resilience=resilience,
             plan_cache_size=int(self.config.get("plan-cache-size", 256)),
             max_inflight_queries=int(self.config.get(
-                "max-inflight-queries", 4)))
+                "max-inflight-queries", 4)),
+            tracer=self._make_tracer(),
+            slow_query_ms=float(self.config.get("slow-query-ms",
+                                                1000.0)))
         self.http.start()
         self.grpc_server = None
         if self.config.get("grpc-port") is not None:
@@ -404,9 +431,11 @@ class FiloServer:
         from filodb_tpu.ingest import IngestionDriver, LogIngestionStream
         stream_dir = self.config["stream-dir"]
         n = self.config["num-shards"]
+        gc_s = float(self.config.get("stream-group-commit-ms", 0)) / 1000
         for shard in self.owned_shards:
             path = os.path.join(stream_dir, f"shard={shard}", "stream.log")
-            self.streams[shard] = LogIngestionStream(path, DEFAULT_SCHEMAS)
+            self.streams[shard] = LogIngestionStream(
+                path, DEFAULT_SCHEMAS, group_commit_s=gc_s)
         for shard in self.owned_shards:
             drv = IngestionDriver(
                 self.store.get_shard(self.ref, shard), self.streams[shard],
@@ -431,7 +460,7 @@ class FiloServer:
                         path = os.path.join(stream_dir, f"shard={shard}",
                                             "stream.log")
                         gw_streams[shard] = LogIngestionStream(
-                            path, DEFAULT_SCHEMAS)
+                            path, DEFAULT_SCHEMAS, group_commit_s=gc_s)
             self._gw_streams = gw_streams
             self.gateway = GatewayServer(
                 gw_streams, DEFAULT_SCHEMAS, num_shards=n,
@@ -536,7 +565,10 @@ class FiloServer:
                                            LogIngestionStream)
             path = os.path.join(self.config["stream-dir"],
                                 f"shard={shard}", "stream.log")
-            stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+            stream = LogIngestionStream(
+                path, DEFAULT_SCHEMAS,
+                group_commit_s=float(self.config.get(
+                    "stream-group-commit-ms", 0)) / 1000)
             self.streams[shard] = stream     # gateway routes to it too
             drv = IngestionDriver(
                 self.store.get_shard(self.ref, shard), stream,

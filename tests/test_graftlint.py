@@ -520,3 +520,120 @@ def test_cli_github_flag(tmp_path):
     assert out.returncode == 1
     assert out.stdout.startswith("::error file=")
     assert "host-transfer-in-hot-loop" in out.stdout
+
+
+# -- span discipline (obs/trace) ---------------------------------------------
+
+SPAN_BARE_OPEN = """
+from filodb_tpu.obs import trace
+
+def f():
+    sp = trace.start_span("work")
+    sp.tag(step=1)
+"""
+
+SPAN_BARE_OPEN_CLEAN = """
+from filodb_tpu.obs import trace
+
+def f():
+    with trace.span("work") as sp:
+        sp.tag(step=1)
+"""
+
+SPAN_DISCARDED = """
+from filodb_tpu.obs import trace
+
+def f():
+    trace.span("work")
+    return 1
+"""
+
+SPAN_HOT_FORMAT = """
+from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import trace
+
+@hot_path
+def serve(x):
+    with trace.span(f"query-{x}"):
+        return x
+"""
+
+SPAN_HOT_FORMAT_GUARDED = """
+from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import trace
+
+@hot_path
+def serve(x):
+    if trace.trace_active():
+        with trace.span("query", xid="%s" % x):
+            return x
+    return x
+"""
+
+SPAN_HOT_RAW_ARGS = """
+from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import trace
+
+@hot_path
+def serve(x, n):
+    with trace.span("query", xid=x, series=n):
+        return x
+"""
+
+SPAN_COLD_FORMAT = """
+from filodb_tpu.obs import trace
+
+def cold(x):
+    with trace.span("query", xid=f"id-{x}"):
+        return x
+"""
+
+SPAN_HOT_TAG_FORMAT = """
+from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import trace
+
+@hot_path
+def serve(x):
+    with trace.span("query") as sp:
+        sp.tag(detail="item {}".format(x))
+        return x
+"""
+
+SPAN_HOT_FORMAT_PRAGMA = """
+from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.obs import trace
+
+@hot_path
+def serve(x):
+    # graftlint: disable=span-discipline (label cost accepted: debug build only)
+    with trace.span(f"query-{x}"):
+        return x
+"""
+
+
+def test_span_discipline_bare_open(tmp_path):
+    assert rules_of(lint_src(tmp_path, SPAN_BARE_OPEN)) \
+        == ["span-discipline"]
+    assert not lint_src(tmp_path, SPAN_BARE_OPEN_CLEAN).findings
+
+
+def test_span_discipline_discarded_span(tmp_path):
+    assert rules_of(lint_src(tmp_path, SPAN_DISCARDED)) \
+        == ["span-discipline"]
+
+
+def test_span_discipline_hot_path_formatting(tmp_path):
+    assert rules_of(lint_src(tmp_path, SPAN_HOT_FORMAT)) \
+        == ["span-discipline"]
+    # behind the sampling guard: formatting only runs when traced
+    assert not lint_src(tmp_path, SPAN_HOT_FORMAT_GUARDED).findings
+    # raw values are free — the span stores them without formatting
+    assert not lint_src(tmp_path, SPAN_HOT_RAW_ARGS).findings
+    # cold (non-@hot_path) code may format freely
+    assert not lint_src(tmp_path, SPAN_COLD_FORMAT).findings
+    # .tag() with formatting in hot scope is the same leak
+    assert rules_of(lint_src(tmp_path, SPAN_HOT_TAG_FORMAT)) \
+        == ["span-discipline"]
+    # pragma with a reason suppresses
+    res = lint_src(tmp_path, SPAN_HOT_FORMAT_PRAGMA)
+    assert not res.findings and res.suppressed == 1

@@ -118,3 +118,72 @@ def test_log_stream_torn_tail_not_consumed(tmp_path):
     consumer2 = LogIngestionStream(path, DEFAULT_SCHEMAS)
     assert consumer2.end_offset() == 3
     assert [sd.offset for sd in consumer2.read(0, 100)] == [0, 1, 2]
+
+
+def test_group_commit_coalesces_fsyncs(tmp_path, monkeypatch):
+    """Group-commit fsync (ROADMAP follow-up): with the window open,
+    consecutive appends share one fsync instead of paying one each; the
+    time/size bounds and close() bound the durability window; the
+    fsync histogram counts real fsyncs only."""
+    from filodb_tpu.obs import metrics as obm
+    obm.GLOBAL_REGISTRY.reset()
+    calls = []
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        calls.append(fd)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    path = str(tmp_path / "gc" / "stream.log")
+    st = LogIngestionStream(path, DEFAULT_SCHEMAS,
+                            group_commit_s=60.0)   # window never closes
+    conts = _containers(n_samples=20)
+    for c in conts:
+        st.append(c)
+    # first append syncs (stale last_sync_t), later ones coalesce
+    assert st.appends == len(conts)
+    assert st.fsyncs < st.appends
+    assert len(calls) == st.fsyncs
+    # reader sees every record regardless of sync state
+    assert st.end_offset() == len(conts)
+    before = st.fsyncs
+    st.sync()                                      # checkpoint barrier
+    assert st.fsyncs == before + 1
+    st.sync()                                      # nothing unsynced
+    assert st.fsyncs == before + 1
+    st.append(conts[0])
+    st.close()                                     # tail forced out
+    assert st.fsyncs == before + 2
+    # histogram counted exactly the real fsyncs
+    h = obm.GLOBAL_REGISTRY.get("filodb_ingest_fsync_seconds")
+    assert h is not None and h.snapshot()["count"] == st.fsyncs
+    ha = obm.GLOBAL_REGISTRY.get("filodb_ingest_append_seconds")
+    assert ha is not None and ha.snapshot()["count"] == st.appends
+    obm.GLOBAL_REGISTRY.reset()
+
+
+def test_group_commit_size_bound_and_strict_default(tmp_path,
+                                                    monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: (calls.append(fd),
+                                    real_fsync(fd))[1])
+    # strict default: every append fsyncs (pre-PR behavior)
+    st = LogIngestionStream(str(tmp_path / "strict" / "s.log"),
+                            DEFAULT_SCHEMAS)
+    conts = _containers(n_samples=5)
+    for c in conts:
+        st.append(c)
+    assert st.fsyncs == len(conts)
+    st.close()
+    # size bound: a tiny byte budget forces a sync despite a huge window
+    calls.clear()
+    st2 = LogIngestionStream(str(tmp_path / "sz" / "s.log"),
+                             DEFAULT_SCHEMAS, group_commit_s=60.0,
+                             group_commit_bytes=1)
+    for c in conts:
+        st2.append(c)
+    assert st2.fsyncs == len(conts)      # every append trips the bound
+    st2.close()

@@ -1,0 +1,144 @@
+"""Prometheus text-format compliance of the whole /metrics exposition:
+a validator that parses every line of a live server's scrape and
+enforces what a real Prometheus scraper requires — # HELP/# TYPE per
+family, valid metric/label names, consistent escaping, no duplicate
+series, and well-formed histograms (cumulative buckets, +Inf == _count).
+"""
+
+import re
+import urllib.request
+
+import pytest
+
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+# one sample line: name, optional {labels}, value
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$")
+_LABEL_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Parse + validate; returns {family: (type, [(name, labels, value)])}.
+    Raises AssertionError on any format violation."""
+    families = {}       # family -> [type, help, samples]
+    seen_series = set()
+    current = None
+    for i, ln in enumerate(text.splitlines(), 1):
+        if not ln.strip():
+            continue
+        if ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            assert len(parts) >= 3, f"line {i}: malformed HELP: {ln!r}"
+            fam = parts[2]
+            assert _NAME_RE.match(fam), f"line {i}: bad family {fam!r}"
+            assert fam not in families, \
+                f"line {i}: duplicate HELP block for {fam}"
+            families[fam] = ["untyped", parts[3] if len(parts) > 3
+                             else "", []]
+            current = fam
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            assert len(parts) == 4, f"line {i}: malformed TYPE: {ln!r}"
+            fam, mtype = parts[2], parts[3]
+            assert fam == current, \
+                f"line {i}: TYPE for {fam} outside its HELP block"
+            assert mtype in ("counter", "gauge", "histogram", "summary",
+                             "untyped"), f"line {i}: bad type {mtype}"
+            families[fam][0] = mtype
+            continue
+        assert not ln.startswith("#"), f"line {i}: stray comment {ln!r}"
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"line {i}: unparseable sample line {ln!r}"
+        name = m.group("name")
+        # the sample must belong to the CURRENT family block (histogram
+        # children use the family prefix)
+        assert current is not None and (
+            name == current or name.startswith(current + "_")), \
+            f"line {i}: sample {name} outside family block {current}"
+        labels = []
+        raw = m.group("labels")
+        if raw is not None:
+            assert raw != "", f"line {i}: empty label braces in {ln!r}"
+            consumed = _LABEL_RE.sub("", raw).strip(",")
+            assert consumed == "", \
+                f"line {i}: malformed labels {raw!r} (left: {consumed!r})"
+            labels = _LABEL_RE.findall(raw)
+        float(m.group("value").replace("+Inf", "inf")
+              .replace("-Inf", "-inf").replace("NaN", "nan"))
+        key = (name, tuple(sorted(labels)))
+        assert key not in seen_series, f"line {i}: duplicate series {key}"
+        seen_series.add(key)
+        families[current][2].append((name, dict(labels),
+                                     m.group("value")))
+    return {fam: (t, samples) for fam, (t, _h, samples)
+            in families.items()}
+
+
+def validate_histograms(families):
+    hists = 0
+    for fam, (mtype, samples) in families.items():
+        if mtype != "histogram":
+            continue
+        hists += 1
+        buckets = [(s[1]["le"], float(s[2])) for s in samples
+                   if s[0] == fam + "_bucket"]
+        counts = [float(s[2]) for s in samples if s[0] == fam + "_count"]
+        sums = [s for s in samples if s[0] == fam + "_sum"]
+        assert buckets and counts and sums, f"{fam}: missing children"
+        assert buckets[-1][0] == "+Inf", f"{fam}: no +Inf bucket"
+        vals = [v for _, v in buckets]
+        assert vals == sorted(vals), f"{fam}: non-cumulative buckets"
+        les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
+        assert les == sorted(les), f"{fam}: unsorted le boundaries"
+        assert buckets[-1][1] == counts[0], \
+            f"{fam}: +Inf bucket != _count"
+    return hists
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "tenant-metering-interval-s": 30}).start()
+    srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
+    # serve one query so the query/batcher/device histograms exist
+    url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+           f"query_range?query=rate(http_requests_total[5m])"
+           f"&start={T0 + 300}&end={T0 + 500}&step=60")
+    urllib.request.urlopen(url, timeout=60).read()
+    yield srv
+    srv.stop()
+
+
+def test_whole_exposition_parses_and_validates(server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    families = parse_exposition(text)
+    assert len(families) > 20
+    # counters are typed counter, gauges gauge
+    assert families["filodb_plan_cache_hits_total"][0] == "counter"
+    assert families["filodb_shard_status"][0] == "gauge"
+    # the acceptance histograms are present and well-formed
+    for fam in ("filodb_query_latency_seconds",
+                "filodb_batcher_queue_wait_seconds",
+                "filodb_device_execute_seconds"):
+        assert fam in families and families[fam][0] == "histogram", fam
+    assert validate_histograms(families) >= 3
+
+
+def test_label_escaping_survives_hostile_values(server):
+    # a label value with quote/backslash/newline must stay parseable
+    from filodb_tpu.obs.metrics import ExpositionBuilder
+    b = ExpositionBuilder()
+    b.sample("filodb_t", {"p": 'x"\\\n'}, 1)
+    families = parse_exposition(b.render())
+    ((_, labels, _),) = families["filodb_t"][1]
+    assert labels["p"] == 'x\\"\\\\\\n'     # escaped on the wire

@@ -93,6 +93,8 @@ def _grpc_rpcs(port) -> int:
             f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
         txt = r.read().decode()
     for line in txt.splitlines():
+        if line.startswith("#"):
+            continue                 # # HELP / # TYPE comment lines
         if "grpc_rpcs_served_total" in line:
             return int(float(line.split()[-1]))
     return 0

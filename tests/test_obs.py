@@ -1,0 +1,237 @@
+"""Unit tests for the observability spine (filodb_tpu.obs): the span
+API's no-op fast path and context plumbing, trace wire round-trips,
+the fixed-bucket histogram, the exposition builder's dedup/escaping,
+and the slow-query / in-flight primitives."""
+
+import json
+import math
+import threading
+
+import pytest
+
+from filodb_tpu.obs import metrics as obm
+from filodb_tpu.obs import trace as obt
+from filodb_tpu.obs.slowlog import InflightRegistry, SlowQueryLog
+
+
+# -- trace -------------------------------------------------------------------
+
+def test_span_is_noop_without_active_trace():
+    assert not obt.trace_active()
+    sp = obt.span("x", a=1)
+    assert sp is obt._NOOP          # the shared no-op, no allocation
+    with sp as s:
+        s.tag(b=2)                  # tag() works on the no-op too
+    assert obt.inject_header() is None
+    obt.event("nothing", c=3)       # no-op, no error
+
+
+def test_span_nesting_and_parentage():
+    tr = obt.Trace(node="n0")
+    with obt.activate(tr):
+        assert obt.trace_active()
+        with obt.span("outer", k="v") as outer:
+            with obt.span("inner"):
+                obt.event("dot", hit=True)
+    assert not obt.trace_active()
+    spans = {s.name: s for s in tr.spans}
+    assert spans["outer"].parent_id is None
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["dot"].parent_id == spans["inner"].span_id
+    assert spans["dot"].dur_ns == 0
+    assert spans["outer"].tags == {"k": "v"}
+    assert spans["outer"].dur_ns >= 0
+    # inner recorded BEFORE outer (exit order), both present
+    assert [s.name for s in tr.spans] == ["dot", "inner", "outer"]
+
+
+def test_span_records_exception_as_error():
+    tr = obt.Trace()
+    with obt.activate(tr):
+        with pytest.raises(ValueError):
+            with obt.span("boom"):
+                raise ValueError("nope")
+    assert tr.spans[0].error == "ValueError: nope"
+
+
+def test_capture_use_across_threads():
+    tr = obt.Trace()
+    got = {}
+
+    def worker(ctx):
+        with obt.use(ctx):
+            with obt.span("on-worker"):
+                pass
+        got["done"] = True
+
+    with obt.activate(tr):
+        with obt.span("parent") as parent:
+            ctx = obt.capture()
+            t = threading.Thread(target=worker, args=(ctx,))
+            t.start()
+            t.join()
+    assert got["done"]
+    by_name = {s.name: s for s in tr.spans}
+    assert by_name["on-worker"].parent_id == by_name["parent"].span_id
+    # use(None) is a harmless no-op
+    with obt.use(None):
+        pass
+
+
+def test_header_roundtrip_and_malformed():
+    tr = obt.Trace("aabbccdd00112233")
+    with obt.activate(tr):
+        with obt.span("s") as sp:
+            hdr = obt.inject_header()
+            assert hdr == f"aabbccdd00112233-{sp.span_id}-1"
+            ctx = obt.parse_context(hdr)
+            assert ctx == ("aabbccdd00112233", sp.span_id)
+    assert obt.parse_context(None) is None
+    assert obt.parse_context("") is None
+    assert obt.parse_context("-") is None
+    assert obt.parse_context("tid") == ("tid", None)
+
+
+def test_spans_wire_roundtrip_and_garbage():
+    tr = obt.Trace("t1")
+    with obt.activate(tr):
+        with obt.span("a", x=1):
+            pass
+    buf = obt.spans_wire(tr)
+    tr2 = obt.Trace("t1")
+    with obt.activate(tr2):
+        obt.absorb_wire(buf)
+        obt.absorb_wire(b"not json")        # tolerated
+        obt.absorb_wire(b"")
+        obt.absorb_spans([{"name": "b", "span_id": "s2",
+                           "dur_us": 5}, "garbage-entry"])
+    names = [s.name for s in tr2.spans]
+    assert names == ["a", "b"]
+    assert tr2.spans[0].tags == {"x": 1}
+
+
+def test_trace_span_cap():
+    tr = obt.Trace()
+    with obt.activate(tr):
+        for _ in range(obt.MAX_SPANS + 10):
+            with obt.span("s"):
+                pass
+    assert len(tr.spans) == obt.MAX_SPANS
+    assert tr.truncated
+
+
+def test_tracer_sampling_ring_and_force():
+    t = obt.Tracer(enabled=False)
+    assert t.start() is None                      # disabled: untraced
+    assert t.start(force=True) is not None        # &explain=trace
+    assert t.start(ctx=("tid", "par")) is not None  # propagated: honored
+    t2 = obt.Tracer(enabled=True, sample_rate=0.0, max_traces=2)
+    assert t2.start() is None and t2.sampled_out == 1
+    t3 = obt.Tracer(enabled=True, max_traces=2)
+    ids = []
+    for _ in range(3):
+        tr = t3.start()
+        t3.finish(tr)
+        ids.append(tr.trace_id)
+    assert t3.get(ids[0]) is None                 # evicted (ring of 2)
+    assert t3.get(ids[2]) is not None
+    assert [x.trace_id for x in t3.recent(10)] == [ids[2], ids[1]]
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_histogram_observe_and_exposition():
+    h = obm.Histogram("t_seconds", "help text",
+                      buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["counts"] == [1, 2, 1, 1]         # per-bucket + +Inf
+    assert snap["sum"] == pytest.approx(56.05)
+    b = obm.ExpositionBuilder()
+    b.histogram(h)
+    text = b.render()
+    assert "# TYPE t_seconds histogram" in text
+    assert 't_seconds_bucket{le="0.1"} 1' in text
+    assert 't_seconds_bucket{le="1"} 3' in text   # cumulative
+    assert 't_seconds_bucket{le="10"} 4' in text
+    assert 't_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_seconds_count 5" in text
+
+
+def test_histogram_quantile_interpolation():
+    h = obm.Histogram("q", "h", buckets=(0.01, 0.1, 1.0))
+    assert math.isnan(h.quantile(0.5))
+    for _ in range(100):
+        h.observe(0.05)       # all in the (0.01, 0.1] bucket
+    q50 = h.quantile(0.5)
+    assert 0.01 < q50 <= 0.1
+    # overflow tail clamps to the top finite bucket
+    h2 = obm.Histogram("q2", "h", buckets=(0.01,))
+    h2.observe(5.0)
+    assert h2.quantile(0.99) == 0.01
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError):
+        obm.Histogram("bad", "h", buckets=(1.0, 0.5))
+
+
+def test_exposition_builder_dedupes_and_escapes():
+    b = obm.ExpositionBuilder()
+    b.sample("m_total", {"p": 'a"b\\c\nd'}, 1, mtype="counter",
+             help="line1\nline2")
+    b.sample("m_total", {"p": 'a"b\\c\nd'}, 99, mtype="counter")
+    text = b.render()
+    # duplicate series dropped (first wins), label escaped, help escaped
+    assert text.count("m_total{") == 1
+    assert 'm_total{p="a\\"b\\\\c\\nd"} 1' in text
+    assert "# HELP m_total line1\\nline2" in text
+    assert "# TYPE m_total counter" in text
+
+
+def test_timed_and_global_registry():
+    obm.GLOBAL_REGISTRY.reset()
+    with obm.timed("x_seconds", "h"):
+        pass
+    h = obm.GLOBAL_REGISTRY.get("x_seconds")
+    assert h is not None and h.snapshot()["count"] == 1
+    obm.observe("x_seconds", "h", 0.2)
+    assert h.snapshot()["count"] == 2
+    obm.GLOBAL_REGISTRY.reset()
+
+
+# -- slowlog -----------------------------------------------------------------
+
+def test_slow_query_log_threshold_and_ring():
+    log = SlowQueryLog(threshold_ms=10, capacity=2)
+    assert not log.maybe_record(5, {"query": "fast"})
+    assert log.maybe_record(50, {"query": "q1"})
+    assert log.maybe_record(60, {"query": "q2"})
+    assert log.maybe_record(70, {"query": "q3"})
+    recs = log.records()
+    assert [r["query"] for r in recs] == ["q3", "q2"]   # ring of 2
+    assert recs[0]["elapsed_ms"] == 70
+    assert log.snapshot()["recorded"] == 3
+    off = SlowQueryLog(threshold_ms=0)
+    assert not off.enabled
+    assert not off.maybe_record(10_000, {"query": "x"})
+
+
+def test_inflight_registry():
+    reg = InflightRegistry()
+    e1 = reg.register("q1", "ds", kind="range")
+    e2 = reg.register("q2", "ds", kind="instant")
+    reg.stage(e1, "execute")
+    snap = reg.snapshot()
+    assert len(snap) == 2 and len(reg) == 2
+    assert snap[0]["query"] == "q1" and snap[0]["stage"] == "execute"
+    assert snap[0]["elapsed_ms"] >= 0
+    assert json.dumps(snap)            # JSON-safe for /debug/queries
+    reg.unregister(e1)
+    reg.unregister(e1)                 # idempotent
+    reg.unregister(None)               # tolerated
+    assert len(reg) == 1
+    reg.unregister(e2)
+    assert reg.snapshot() == []

@@ -1,11 +1,15 @@
 """Observability: /metrics Prometheus exposition + query stats in API
 responses (TimeSeriesShardStats surface, TimeSeriesShard.scala:41; QueryStats
-threaded through results, core/query/QueryContext.scala).
+threaded through results, core/query/QueryContext.scala), the stage
+latency histograms, the slow-query log / in-flight registry debug
+endpoints, and the TenantMetering daemon-thread lifecycle.
 """
 
 import json
+import time
 import urllib.request
 
+from filodb_tpu.core.metering import TenantMetering
 from filodb_tpu.standalone.server import FiloServer
 
 T0 = 1_600_000_000
@@ -17,16 +21,31 @@ def _get_text(port, path):
         return r.headers.get("Content-Type", ""), r.read().decode()
 
 
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _samples(text):
+    """{series_line_without_value: float} for every non-comment line."""
+    out = {}
+    for ln in text.strip().splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        name, val = ln.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+
 def test_metrics_and_query_stats():
-    srv = FiloServer({"num-shards": 2, "port": 0}).start()
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "slow-query-ms": 0.001}).start()
     try:
         srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
         ctype, text = _get_text(srv.port, "/metrics")
         assert ctype.startswith("text/plain")
-        lines = dict()
-        for ln in text.strip().splitlines():
-            name, val = ln.rsplit(" ", 1)
-            lines[name] = float(val)
+        lines = _samples(text)
         # per-shard ingest gauges present and summing to the seeded rows
         ingested = sum(v for k, v in lines.items()
                        if k.startswith("filodb_rows_ingested"))
@@ -37,6 +56,10 @@ def test_metrics_and_query_stats():
                    for k in lines)
         assert any(k.startswith("filodb_tile_builds_total")
                    for k in lines)
+        # every family carries # HELP and # TYPE
+        assert "# HELP filodb_rows_ingested" in text
+        assert "# TYPE filodb_shard_status gauge" in text
+        assert "# TYPE filodb_plan_cache_hits_total counter" in text
 
         # query stats ride the API response
         url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
@@ -52,8 +75,132 @@ def test_metrics_and_query_stats():
         tm = st["timings"]
         assert tm["execMs"] >= 0 and tm["plan"]
 
-        # tile cache counters move once the backend served a query
+        # stage-latency histograms appear once a query was served:
+        # well-formed _bucket/_sum/_count with # TYPE histogram
         _, text2 = _get_text(srv.port, "/metrics")
-        assert "filodb_tile_builds_total" in text2
+        assert "# TYPE filodb_query_latency_seconds histogram" in text2
+        lines2 = _samples(text2)
+        assert lines2['filodb_query_latency_seconds_bucket{le="+Inf"}'] \
+            >= 1
+        assert "filodb_query_latency_seconds_count" in lines2
+        assert any(k.startswith("filodb_batcher_queue_wait_seconds_bucket")
+                   for k in lines2)
+        assert any(k.startswith("filodb_device_execute_seconds_bucket")
+                   for k in lines2)
     finally:
         srv.stop()
+
+
+def test_debug_queries_and_slow_query_log():
+    # threshold of ~0: every query lands in the slow-query log with a
+    # per-stage breakdown summing to ~the total
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "slow-query-ms": 0.001}).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
+        url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+               f"query_range?query=rate(http_requests_total[5m])"
+               f"&start={T0 + 300}&end={T0 + 500}&step=60")
+        json.loads(urllib.request.urlopen(url, timeout=60).read())
+        body = _get_json(srv.port, "/debug/slow_queries")
+        assert body["status"] == "success"
+        assert body["summary"]["recorded"] >= 1
+        rec = body["data"][0]
+        assert rec["query"] == "rate(http_requests_total[5m])"
+        assert rec["dataset"] == "timeseries"
+        assert rec["shards"] == [0, 1]
+        assert rec["seriesScanned"] == 3
+        stages = rec["stages"]
+        # per-stage breakdown sums to ~total (encode of the sampled
+        # response shape is in-stage; envelope write is outside)
+        stage_sum = sum(v for k, v in stages.items()
+                        if k.endswith("Ms"))
+        assert stage_sum <= rec["elapsed_ms"] + 1e-3
+        assert stage_sum >= 0.5 * rec["elapsed_ms"]
+        # in-flight registry is empty once the query finished
+        body = _get_json(srv.port, "/debug/queries")
+        assert body["status"] == "success" and body["data"] == []
+    finally:
+        srv.stop()
+
+
+def test_explain_trace_and_debug_traces():
+    srv = FiloServer({"num-shards": 2, "port": 0}).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
+        url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+               f"query_range?query=rate(http_requests_total[5m])"
+               f"&start={T0 + 300}&end={T0 + 500}&step=60"
+               f"&explain=trace")
+        body = json.loads(urllib.request.urlopen(url, timeout=60).read())
+        assert body["status"] == "success"
+        tr = body["trace"]
+        names = {s["name"] for s in tr["spans"]}
+        # the single-node span catalog: edge stages + engine + device
+        assert {"query", "parse", "plan", "execute",
+                "select-series", "device-eval", "encode"} <= names, names
+        # one stitched parent chain: every non-root span's parent exists
+        ids = {s["span_id"] for s in tr["spans"]}
+        roots = [s for s in tr["spans"] if s["parent_id"] is None]
+        assert len(roots) == 1 and roots[0]["name"] == "query"
+        for s in tr["spans"]:
+            if s["parent_id"] is not None:
+                assert s["parent_id"] in ids
+        # retrievable from the ring buffer
+        listing = _get_json(srv.port, "/debug/traces")
+        assert any(t["trace_id"] == tr["trace_id"]
+                   for t in listing["data"])
+        one = _get_json(srv.port, f"/debug/traces?id={tr['trace_id']}")
+        assert one["data"]["num_spans"] == tr["num_spans"]
+
+        # tracing was NOT globally enabled: a plain query stays on the
+        # pre-encoded fast path with no trace keys
+        plain = json.loads(urllib.request.urlopen(
+            url.replace("&explain=trace", ""), timeout=60).read())
+        assert "trace" not in plain and "trace_spans" not in plain
+    finally:
+        srv.stop()
+
+
+def test_tenant_metering_lifecycle_and_gauges():
+    class _Rec:
+        def __init__(self, prefix):
+            self.prefix = prefix
+            self.ts_count = 5
+            self.active_ts_count = 3
+
+    class _Tracker:
+        def scan(self, prefix, depth):
+            return [_Rec(("demo", "App-0"))]
+
+    m = TenantMetering({0: _Tracker()}, interval_s=0.05)
+    assert not m.alive
+    m.start()
+    assert m.alive
+    assert m.snapshots >= 1 and m.latest[("demo", "App-0")] == (5, 3)
+    time.sleep(0.15)
+    assert m.snapshots >= 2            # the loop ticks
+    assert m.last_snapshot_age_s is not None \
+        and m.last_snapshot_age_s < 5
+    m.stop()
+    assert not m.alive                  # joined, not orphaned
+    m.stop()                            # idempotent
+    # stop before start is safe too
+    m2 = TenantMetering({0: _Tracker()}, interval_s=60)
+    m2.stop()
+    assert not m2.alive
+
+
+def test_server_stops_metering_thread():
+    srv = FiloServer({"num-shards": 1, "port": 0,
+                      "tenant-metering-interval-s": 0.1}).start()
+    meter = srv.tenant_metering
+    assert meter is not None and meter.alive
+    # interval + last-snapshot age are exported
+    _, text = _get_text(srv.port, "/metrics")
+    lines = _samples(text)
+    assert lines["filodb_tenant_metering_interval_seconds"] == 0.1
+    assert "filodb_tenant_metering_last_snapshot_age_seconds" in lines
+    assert lines["filodb_tenant_metering_snapshots_total"] >= 1
+    srv.stop()
+    assert not meter.alive              # stopped AND joined on shutdown

@@ -1,0 +1,230 @@
+"""Distributed-trace propagation across the cluster planes
+(obs/trace + grpcsvc + parallel/cluster), under chaos faults:
+
+  * a range query through a 2-node cluster on the gRPC data plane with
+    one injected transport failure yields ONE stitched trace — entry
+    node stages, the remote-peer subspan, the failed attempt as a
+    SIBLING span tagged with the failure, and the peer's own spans
+    shipped back over the wire;
+  * the gRPC -> HTTP plane fallback keeps propagating the context
+    (header on the JSON control plane) and stitches the peer's spans;
+  * breaker rejections land as point events on the trace;
+  * with tracing disabled (the default), responses carry no trace keys
+    and stay on the canonical pre-encoded fast path byte-for-byte.
+"""
+
+import json
+import socket
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+N_SAMPLES = 60
+N_INSTANCES = 4
+QUERY = 'rate({_metric_=~"heap_usage|http_requests_total"}[5m])'
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}?{qs}", timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _query(port, **extra):
+    return _get(port, "/promql/timeseries/api/v1/query_range",
+                query=QUERY, start=T0 + 300,
+                end=T0 + (N_SAMPLES - 1) * 10, step=60, **extra)
+
+
+@pytest.fixture
+def cluster():
+    """Two in-process nodes, half the shards each, gRPC data plane with
+    HTTP fallback. Failure detection polls too slowly to react — the
+    trace must capture what the exec layer does in that window."""
+    pytest.importorskip("grpc")
+    p0, p1 = _free_port(), _free_port()
+    g0, g1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    grpc_peers = {"node0": f"127.0.0.1:{g0}",
+                  "node1": f"127.0.0.1:{g1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "grpc-peers": grpc_peers,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": 300.0,
+        "query-timeout-s": 8.0,
+        "peer-retry-attempts": 3,
+        "peer-retry-base-delay-s": 0.01,
+        "breaker-failure-threshold": 5,
+        "breaker-reset-s": 0.3,
+    }
+    a = FiloServer({**base, "node-ordinal": 0, "port": p0,
+                    "grpc-port": g0}).start()
+    a.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    b = FiloServer({**base, "node-ordinal": 1, "port": p1,
+                    "grpc-port": g1}).start()
+    b.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    try:
+        yield a, b
+    finally:
+        chaos.uninstall()
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+
+def _spans_by_name(tr):
+    by = {}
+    for s in tr["spans"]:
+        by.setdefault(s["name"], []).append(s)
+    return by
+
+
+def test_stitched_trace_across_grpc_with_injected_retry(cluster):
+    a, b = cluster
+    inj = chaos.ChaosInjector()
+    # exactly ONE transport failure against node1's gRPC service: the
+    # second attempt succeeds, so the query completes normally
+    inj.fail("grpc.call", times=1,
+             match=lambda c: c.get("node") == "node1")
+    with inj:
+        body = _query(a.port, **{"explain": "trace"})
+    assert body["status"] == "success"
+    assert len(body["data"]["result"]) >= 2 * N_INSTANCES
+    tr = body["trace"]
+    spans = tr["spans"]
+    assert tr["num_spans"] == len(spans) >= 10, tr["num_spans"]
+    by = _spans_by_name(tr)
+    ids = {s["span_id"] for s in spans}
+
+    # ONE stitched trace: a single root, every parent resolves
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "query"
+    for s in spans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+
+    # entry-node stage catalog
+    for name in ("parse", "plan", "execute", "select-series",
+                 "device-eval", "encode"):
+        assert name in by, (name, sorted(by))
+
+    # the remote-peer subspan with the peer's OWN spans stitched under
+    # the successful attempt (trace context crossed the gRPC wire)
+    (peer,) = by["remote-peer"]
+    assert peer["tags"]["node"] == "node1"
+    assert peer["tags"]["plane"] == "grpc"
+    attempts = sorted(by["peer-attempt"], key=lambda s: s["start_us"])
+    assert len(attempts) == 2
+    # siblings under the remote-peer span; the first tagged w/ failure
+    assert {s["parent_id"] for s in attempts} == {peer["span_id"]}
+    assert attempts[0]["tags"]["retry"] is False
+    assert "error" in attempts[0] and "unreachable" in \
+        attempts[0]["error"]
+    assert attempts[1]["tags"]["retry"] is True
+    assert "error" not in attempts[1]
+    remote = by["peer-fetch-raw"]
+    assert remote and remote[0]["tags"]["node"] == "node1"
+    assert remote[0]["parent_id"] == attempts[1]["span_id"]
+    # the peer's select span rides under its peer-fetch-raw span
+    selects = by["select-span"]
+    assert any(s["parent_id"] == remote[0]["span_id"] for s in selects)
+
+    # the trace is retrievable from the entry node's ring buffer and
+    # identical in span count
+    stored = _get(a.port, "/debug/traces", id=tr["trace_id"])
+    assert stored["data"]["num_spans"] == tr["num_spans"]
+
+
+def test_fallback_to_http_plane_keeps_the_trace(cluster):
+    a, b = cluster
+    inj = chaos.ChaosInjector()
+    # every gRPC dial to node1 fails -> retries exhaust -> the client
+    # downgrades to the JSON control plane, which must keep propagating
+    # the trace context via the HTTP header
+    inj.fail("grpc.call", match=lambda c: c.get("node") == "node1")
+    with inj:
+        body = _query(a.port, **{"explain": "trace"})
+    assert body["status"] == "success"
+    by = _spans_by_name(body["trace"])
+    planes = {s["tags"]["plane"] for s in by["remote-peer"]}
+    assert planes == {"grpc", "http"}       # nested fallback hop
+    assert "plane-fallback" in by
+    # the peer's spans arrived over the HTTP plane response envelope
+    remote = [s for s in by["peer-fetch-raw"]
+              if s["tags"].get("plane") == "http"]
+    assert remote and remote[0]["tags"]["node"] == "node1"
+    # failed gRPC attempts are siblings tagged with the failure
+    failed = [s for s in by["peer-attempt"] if "error" in s]
+    assert len(failed) == 3                 # retry policy exhausted
+
+
+def test_breaker_rejection_lands_on_the_trace(cluster):
+    a, b = cluster
+    # trip node1's breaker at the entry node (threshold 5): the next
+    # dial is REJECTED without being attempted. With allow_partial the
+    # query still succeeds (peer's shard group drops out) and the trace
+    # must carry the rejection as a point event under the remote hop.
+    reg = a.http.resilience.breakers
+    addr = a.http.grpc_peers["node1"]
+    br = reg.get(addr)
+    for _ in range(5):
+        br.record_failure()
+    assert br.state == "open"
+    body = _query(a.port, **{"explain": "trace",
+                             "allow_partial": "true"})
+    assert body["status"] == "success" and body.get("partial") is True
+    by = _spans_by_name(body["trace"])
+    (rej,) = by["breaker-rejected"]
+    assert rej["tags"]["peer"] == "node1" and rej["dur_us"] == 0
+    # rejected = not dialed: no attempt spans, no peer subspans
+    assert "peer-attempt" not in by
+    assert "peer-fetch-raw" not in by
+
+
+def test_disabled_tracing_responses_are_byte_identical(cluster):
+    """Tracing off (default): the response must stay on the canonical
+    compact-JSON fast path with NO trace keys — re-encoding the parsed
+    body compactly reproduces the exact bytes (the pre-PR encoder
+    contract), and equal requests return equal bytes modulo the
+    wall-clock timings block."""
+    a, b = cluster
+    qs = urllib.parse.urlencode(
+        dict(query=QUERY, start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10,
+             step=60))
+    url = (f"http://127.0.0.1:{a.port}/promql/timeseries/api/v1/"
+           f"query_range?{qs}")
+    with urllib.request.urlopen(url, timeout=120) as r:
+        raw1 = r.read()
+    with urllib.request.urlopen(url, timeout=120) as r:
+        raw2 = r.read()
+    parsed1 = json.loads(raw1)
+    parsed2 = json.loads(raw2)
+    assert "trace" not in parsed1 and "trace_spans" not in parsed1
+    # canonical compact encoding: matrix_bytes output == compact dump
+    assert raw1 == json.dumps(parsed1, separators=(",", ":")).encode()
+    # identical request -> identical bytes modulo wall-clock timings
+    parsed1["stats"].pop("timings")
+    parsed2["stats"].pop("timings")
+    assert parsed1 == parsed2
+    # and nothing was traced server-side
+    assert a.http.tracer.snapshot()["started"] == 0
