@@ -435,6 +435,39 @@ class TimeSeriesPartition:
             parts.append(tail_drops + off)
         return np.concatenate(parts)
 
+    def cache_bytes(self) -> int:
+        """Bytes held by this partition's decode + merge caches (the
+        ``filodb_decode_cache_bytes`` gauge input)."""
+        with self._cache_lock:
+            return self._cache_bytes_locked()
+
+    def _cache_bytes_locked(self) -> int:
+        n = 0
+        for entry in self._decode_cache.values():
+            for part in entry[1]:
+                n += int(part.nbytes)
+            for part in entry[2]:
+                n += int(getattr(part, "nbytes", 0))
+        for cached in self._merge_cache.values():
+            n += int(cached[2].nbytes) + int(getattr(cached[3],
+                                                     "nbytes", 0))
+        return n
+
+    def release_caches(self) -> int:
+        """Drop the decoded-chunk and merge caches when every published
+        chunk sits in the flushed/persisted prefix — those decodes are
+        pure duplicates of immutable chunk bytes (re-decodable on the
+        next read), so under memory pressure they are the first thing to
+        give back. Partitions with unflushed chunks keep their caches
+        (they are the hot, actively-queried head). Returns bytes freed."""
+        with self._cache_lock:
+            if self.persisted_chunks < len(self.chunks):
+                return 0
+            n = self._cache_bytes_locked()
+            self._decode_cache.clear()
+            self._merge_cache.clear()
+            return n
+
     def read_range(self, start_ts: int, end_ts: int, col_index: int
                    ) -> Tuple[np.ndarray, np.ndarray]:
         """All samples with start_ts <= t <= end_ts for one data column.
@@ -501,6 +534,13 @@ class TimeSeriesShard:
         # per-group ingestion checkpoint offsets (CheckpointTable semantics)
         self.checkpoints: Dict[int, int] = {}
         self._resident = 0      # running resident-sample count
+        # high-water mark of ingested sample timestamps (ms); -1 until
+        # the first row lands. The results cache's freshness horizon:
+        # steps at/below the watermark are settled (the per-partition
+        # OOO guard drops older rows), steps above it may still fill in.
+        # A REGRESSION (new shard object replaying, adoption) signals
+        # cached results built against this shard must be invalidated.
+        self.ingest_watermark_ms = -1
         # serializes ODP page-ins (queries arrive from concurrent HTTP
         # threads; page-in rebinds part.chunks — everything else on the
         # read path sees immutable snapshots and needs no lock)
@@ -584,6 +624,8 @@ class TimeSeriesShard:
                 last = part.last_timestamp
                 if last is not None:
                     self.index.update_end_time(part.part_id, last)
+                    if last > self.ingest_watermark_ms:
+                        self.ingest_watermark_ms = int(last)
             self.stats.out_of_order_dropped += (j - i) - got
         self.stats.rows_ingested += n
         if offset >= 0:
@@ -742,6 +784,32 @@ class TimeSeriesShard:
         for p in self.partitions.values():
             n += sum(c.num_rows for c in p.chunks) + p._buf_rows
         return n
+
+    def decode_cache_bytes(self) -> int:
+        """Total bytes in per-partition decode/merge caches (the
+        ``filodb_decode_cache_bytes`` gauge — previously this memory was
+        unbounded and invisible)."""
+        return sum(p.cache_bytes() for p in list(self.partitions.values()))
+
+    def trim_decode_caches(self, max_bytes: int) -> int:
+        """Memory-bound the host decode/merge caches: when their total
+        exceeds ``max_bytes``, release the caches of least-recently-
+        written partitions whose chunks are all flushed/persisted (pure
+        duplicates of immutable chunk bytes) until under budget. Runs on
+        the ingest driver's flush path. Returns bytes freed."""
+        if max_bytes <= 0:
+            return 0
+        total = self.decode_cache_bytes()
+        if total <= max_bytes:
+            return 0
+        freed = 0
+        parts = sorted(list(self.partitions.values()),
+                       key=lambda p: p.last_timestamp or 0)
+        for p in parts:
+            if total - freed <= max_bytes:
+                break
+            freed += p.release_caches()
+        return freed
 
     def ensure_headroom(self, max_samples: int,
                         headroom_pct: int = 25) -> int:

@@ -187,7 +187,8 @@ class GrpcRemoteExec:
                  retry: Optional[RetryPolicy] = None,
                  breakers: Optional[BreakerRegistry] = None,
                  deadline: Optional[Deadline] = None,
-                 http_fallback: Optional[str] = None):
+                 http_fallback: Optional[str] = None,
+                 no_cache: bool = False):
         # structural plan tree (query.planwire); when present the peer
         # executes it directly and `query` is only a debug label
         self.plan_wire = plan_wire
@@ -205,6 +206,9 @@ class GrpcRemoteExec:
         self.breakers = breakers
         self.deadline = deadline
         self.http_fallback = http_fallback
+        # &cache=false propagation across the binary plane (ExecRequest
+        # field 11): the peer skips its results cache for this query
+        self.no_cache = no_cache
 
     def _fallback_exec(self):
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
@@ -213,7 +217,8 @@ class GrpcRemoteExec:
             self.node_id, self.http_fallback, self.dataset,
             timeout_s=self.timeout_s, stats=self.stats,
             local_only=self.local_only, retry=self.retry,
-            breakers=self.breakers, deadline=self.deadline)
+            breakers=self.breakers, deadline=self.deadline,
+            no_cache=self.no_cache)
 
     def _deadline_ms(self) -> int:
         if self.deadline is None:
@@ -235,7 +240,8 @@ class GrpcRemoteExec:
                 self.end_ms, local_only=self.local_only,
                 plan_wire=self.plan_wire,
                 deadline_ms=self._deadline_ms(),
-                trace_ctx=obs_trace.inject_header() or "")
+                trace_ctx=obs_trace.inject_header() or "",
+                no_cache=self.no_cache)
             return _call(self.addr, "Exec", payload, timeout_s,
                          self.node_id)
 

@@ -143,7 +143,8 @@ class GrpcQueryServer:
             engine = self.http.make_planner(
                 req["dataset"], local_dispatch=req["local_only"],
                 deadline=self._req_deadline(
-                    req, getattr(self.http, "query_timeout_s", 30.0)))
+                    req, getattr(self.http, "query_timeout_s", 30.0)),
+                no_result_cache=bool(req.get("no_cache")))
             if engine is None:
                 return wire.encode_exec_response(
                     None, error=f"dataset {req['dataset']} not set up",
@@ -166,7 +167,18 @@ class GrpcQueryServer:
                 else:
                     plan = parse_query(req["query"],
                                        req["start_ms"] // 1000)
-                res = engine.execute(plan)
+                rc = getattr(self.http, "result_cache", None)
+                if rc is not None and not req["plan_wire"] \
+                        and req["step_ms"] > 0:
+                    # pushdown/federation range queries share the
+                    # node's results cache (the &cache=false escape
+                    # hatch rides ExecRequest field 11 as no_cache)
+                    res, _ses = rc.execute(
+                        engine, req["dataset"], req["query"], plan,
+                        req["start_ms"], req["step_ms"], req["end_ms"],
+                        bypass=bool(req.get("no_cache")))
+                else:
+                    res = engine.execute(plan)
             if isinstance(res, ScalarResult):
                 res = GridResult(res.steps, [{}], res.values[None, :])
             return wire.encode_exec_response(

@@ -288,13 +288,15 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
                         local_only: bool = True,
                         plan_wire: bytes = b"",
                         deadline_ms: int = 0,
-                        trace_ctx: str = "") -> bytes:
+                        trace_ctx: str = "",
+                        no_cache: bool = False) -> bytes:
     """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
     the reference's exec_plan.proto capability; the printed query text
     stays alongside for debuggability and older peers. Field 9 carries
     the caller's remaining deadline budget in ms (server-side deadline
     propagation; 0/absent = none). Field 10 carries the propagated
-    trace context (absent = untraced)."""
+    trace context (absent = untraced). Field 11 propagates the caller's
+    results-cache bypass (&cache=false) so the peer skips its cache."""
     out = (_ld(1, dataset.encode()) + _ld(2, query.encode())
            + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
            + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
@@ -304,13 +306,15 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
         out += _vi(9, int(deadline_ms))
     if trace_ctx:
         out += _ld(10, trace_ctx.encode())
+    if no_cache:
+        out += _vi(11, 1)
     return out
 
 
 def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
            "end_ms": 0, "local_only": True, "plan_wire": b"",
-           "deadline_ms": 0, "trace": ""}
+           "deadline_ms": 0, "trace": "", "no_cache": False}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -330,6 +334,8 @@ def decode_exec_request(buf: bytes) -> Dict:
             req["deadline_ms"] = _signed(v)
         elif f == 10:
             req["trace"] = v.decode()
+        elif f == 11:
+            req["no_cache"] = bool(v)
     return req
 
 

@@ -97,39 +97,54 @@ def _ts_frag(t: float) -> str:
 
 
 def matrix_bytes(grid: GridResult, stats_json: Dict,
-                 warnings=None, partial: bool = False) -> PreEncoded:
+                 warnings=None, partial: bool = False,
+                 rows_memo=None) -> PreEncoded:
     """Serving fast path: a range-query matrix response encoded straight
     to JSON bytes. Byte-identical to ``json.dumps(matrix(grid)
     [+stats/degraded], separators=(",", ":"))`` — pinned by
     tests/test_http_e2e-style golden comparisons in test_plancache.
 
     Only the plain scalar-matrix shape takes this path (histogram wire
-    and scalar results keep the dict path)."""
-    rows: List[str] = []
-    steps_s = grid.steps / 1000.0
-    memo = _FMT_MEMO
-    if len(memo) > _FMT_MEMO_MAX:
-        memo.clear()
-    for i, key in enumerate(grid.keys):
-        row = grid.values[i]
-        ok = ~np.isnan(row)
-        if not ok.any():
-            continue
-        vals = row[ok]
-        ts = steps_s[ok].tolist()
-        metric = json.dumps(_metric(key), separators=(",", ":"))
-        if np.isinf(vals).any():
-            frags = [f'[{_ts_frag(t)},"{_fmt(v)}"]'
-                     for t, v in zip(ts, vals.tolist())]
-        else:
-            frags = []
-            for t, v in zip(ts, vals.tolist()):
-                s = memo.get(v)
-                if s is None:
-                    memo[v] = s = repr(v)
-                frags.append(f'[{_ts_frag(t)},"{s}"]')
-        rows.append('{"metric":%s,"values":[%s]}'
-                    % (metric, ",".join(frags)))
+    and scalar results keep the dict path).
+
+    ``rows_memo`` is a results-cache handle (``.get() -> str|None``,
+    ``.put(text)``) present only on a FULL hit: the rendered result-row
+    text is a pure function of the (immutable) cached extent and the
+    range, so repeat hits splice the memoized rows and re-encode only
+    the per-request stats tail; stored text is charged against the
+    cache's byte budget. Racing writers store identical strings."""
+    joined = None
+    if rows_memo is not None:
+        joined = rows_memo.get()
+    if joined is None:
+        rows: List[str] = []
+        steps_s = grid.steps / 1000.0
+        memo = _FMT_MEMO
+        if len(memo) > _FMT_MEMO_MAX:
+            memo.clear()
+        for i, key in enumerate(grid.keys):
+            row = grid.values[i]
+            ok = ~np.isnan(row)
+            if not ok.any():
+                continue
+            vals = row[ok]
+            ts = steps_s[ok].tolist()
+            metric = json.dumps(_metric(key), separators=(",", ":"))
+            if np.isinf(vals).any():
+                frags = [f'[{_ts_frag(t)},"{_fmt(v)}"]'
+                         for t, v in zip(ts, vals.tolist())]
+            else:
+                frags = []
+                for t, v in zip(ts, vals.tolist()):
+                    s = memo.get(v)
+                    if s is None:
+                        memo[v] = s = repr(v)
+                    frags.append(f'[{_ts_frag(t)},"{s}"]')
+            rows.append('{"metric":%s,"values":[%s]}'
+                        % (metric, ",".join(frags)))
+        joined = ",".join(rows)
+        if rows_memo is not None:
+            rows_memo.put(joined)
     tail = ',"stats":' + json.dumps(stats_json, separators=(",", ":"))
     if warnings:
         tail += ',"warnings":' + json.dumps(sorted(set(warnings)),
@@ -137,7 +152,7 @@ def matrix_bytes(grid: GridResult, stats_json: Dict,
     if partial:
         tail += ',"partial":true'
     body = ('{"status":"success","data":{"resultType":"matrix",'
-            '"result":[' + ",".join(rows) + "]}" + tail + "}")
+            '"result":[' + joined + "]}" + tail + "}")
     return PreEncoded(body.encode())
 
 

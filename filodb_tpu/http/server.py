@@ -82,6 +82,8 @@ class FiloHttpServer:
                  query_timeout_s: float = 30.0,
                  resilience: Optional[PeerResilience] = None,
                  plan_cache_size: int = 256,
+                 results_cache_mb: float = 64.0,
+                 results_cache_hot_window_ms: float = 10_000.0,
                  max_inflight_queries: int = 4,
                  tracer: Optional[Tracer] = None,
                  slow_query_ms: float = 1000.0,
@@ -148,6 +150,18 @@ class FiloHttpServer:
                     lambda ev: self.plan_cache.invalidate("topology"))
             except Exception:       # mapper without event support
                 pass
+        # incremental range-query results cache (query/resultcache.py):
+        # per-step matrix extents keyed on the plan cache's range-
+        # abstracted key + step alignment; sliding-window dashboard
+        # re-issues recompute only the uncovered tail. Topology/schema
+        # invalidation rides the plan cache's listener hook; freshness
+        # is bounded by shard ingest watermarks + the hot window.
+        from filodb_tpu.query.resultcache import ResultCache
+        self.result_cache = ResultCache(
+            max_bytes=int(float(results_cache_mb) * (1 << 20)),
+            hot_window_ms=float(results_cache_hot_window_ms))
+        self.plan_cache.add_invalidation_listener(
+            self.result_cache.invalidate)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -373,9 +387,14 @@ class FiloHttpServer:
         deadline = Deadline.after(timeout_s)
         allow_partial = (self._param(qs, "allow_partial", "")
                          or "").lower() in ("true", "1", "yes")
+        # &cache=false: results-cache escape hatch — this query neither
+        # reads nor seeds the cache, and pushdown hops propagate the flag
+        no_cache = (self._param(qs, "cache", "")
+                    or "").lower() in ("false", "0", "no")
         engine = self.make_planner(ds, local_dispatch=local_dispatch,
                                    deadline=deadline,
-                                   allow_partial=allow_partial)
+                                   allow_partial=allow_partial,
+                                   no_result_cache=no_cache)
         if engine is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         if rest == "query_range":
@@ -401,7 +420,8 @@ class FiloHttpServer:
 
     def make_planner(self, ds: str, local_dispatch: bool = False,
                      deadline: Optional[Deadline] = None,
-                     allow_partial: bool = False):
+                     allow_partial: bool = False,
+                     no_result_cache: bool = False):
         """Planner over this node's view of a dataset (shared by the HTTP
         endpoints and the gRPC query service). ``local_dispatch`` pins
         evaluation to local shards — no peer fan-out, no federation."""
@@ -415,6 +435,7 @@ class FiloHttpServer:
         return QueryPlanner(shards, backend=self.backend,
                             deadline=deadline,
                             allow_partial=allow_partial,
+                            no_result_cache=no_result_cache,
                             resilience=self.resilience,
                             shard_mapper=self.shard_mapper,
                             mesh_executor=self.mesh_executor,
@@ -535,17 +556,29 @@ class FiloHttpServer:
             sp.tag(plan_cache=pc_state)
         t1 = _time.perf_counter()
         self.inflight.stage(entry, "plan")
+        bypass = (self._param(qs, "cache", "")
+                  or "").lower() in ("false", "0", "no")
         with obs_trace.span("plan"):
-            ex = engine.materialize(plan)
+            # results cache: split the request into the cached extent
+            # and the uncovered spans — only the latter materialize
+            # (tail-only recomputation; a full hit materializes nothing)
+            ses = self.result_cache.begin(
+                engine, ds, query, plan, start * 1000, step * 1000,
+                end * 1000, bypass=bypass)
+            exs = [engine.materialize(p) for p in ses.plans]
+        ex_label = type(exs[-1]).__name__ if exs else "ResultCacheHit"
         t2 = _time.perf_counter()
         self.inflight.stage(entry, "execute")
-        with obs_trace.span("execute", plan=type(ex).__name__):
-            res = ex.execute()
+        with obs_trace.span("execute", plan=ex_label) as _esp:
+            res = ses.finish(engine, [ex.execute() for ex in exs])
+            _esp.tag(result_cache=ses.state,
+                     cached_steps=ses.cached_steps)
         t3 = _time.perf_counter()
         stages["parseMs"] = round((t1 - t0) * 1000, 3)
         stages["planMs"] = round((t2 - t1) * 1000, 3)
         stages["execMs"] = round((t3 - t2) * 1000, 3)
         stages["planCache"] = pc_state
+        stages["resultCache"] = ses.state
         if isinstance(res, ScalarResult):
             return 200, prom_json.scalar(res, instant=False)
         hist_wire = bool(self._param(qs, "hist-wire"))
@@ -554,8 +587,9 @@ class FiloHttpServer:
             "parseMs": stages["parseMs"],
             "planMs": stages["planMs"],
             "execMs": stages["execMs"],
-            "plan": type(ex).__name__,
+            "plan": ex_label,
             "planCache": pc_state,
+            "resultCache": ses.state,
         }
         self.inflight.stage(entry, "encode")
         if isinstance(res, GridResult) and not hist_wire \
@@ -570,7 +604,8 @@ class FiloHttpServer:
             warnings.extend(res.warnings)
             partial = bool(getattr(st, "partial", False) or res.partial)
             out = prom_json.matrix_bytes(
-                res, stats_json, warnings=warnings, partial=partial)
+                res, stats_json, warnings=warnings, partial=partial,
+                rows_memo=ses.encode_memo())
             stages["encodeMs"] = round(
                 (_time.perf_counter() - t3) * 1000, 3)
             return 200, out
@@ -808,6 +843,40 @@ class FiloHttpServer:
             "Cached plans rebased onto a new range",
         "filodb_plan_cache_invalidations_total":
             "Topology/schema invalidations",
+        "filodb_result_cache_entries": "Results-cache extents resident",
+        "filodb_result_cache_bytes": "Results-cache bytes resident "
+                                     "(byte-accounted LRU)",
+        "filodb_result_cache_hits_total":
+            "Range queries answered entirely from cached extents",
+        "filodb_result_cache_partial_hits_total":
+            "Range queries stitched from a cached extent + a "
+            "recomputed head/tail",
+        "filodb_result_cache_misses_total": "Results-cache misses",
+        "filodb_result_cache_stitches_total":
+            "Span evaluations stitched into cached extents",
+        "filodb_result_cache_churn_recomputes_total":
+            "Series churn forced a full fresh recompute",
+        "filodb_result_cache_bypassed_total":
+            "Queries carrying the &cache=false escape hatch",
+        "filodb_result_cache_degraded_skips_total":
+            "Partial/degraded results refused admission to the cache",
+        "filodb_result_cache_evictions_total":
+            "Extents evicted by the byte-budget LRU",
+        "filodb_result_cache_invalidations_total":
+            "Topology/schema invalidations (shared with the plan cache)",
+        "filodb_result_cache_watermark_invalidations_total":
+            "Extents dropped on ingest-watermark regression "
+            "(replay/recovery)",
+        "filodb_result_cache_cached_steps_served_total":
+            "Steps served from cached extents",
+        "filodb_result_cache_computed_steps_served_total":
+            "Steps recomputed through the pipeline",
+        "filodb_decode_cache_bytes":
+            "Per-shard decode/merge cache bytes (bounded by "
+            "decode-cache-mb)",
+        "filodb_ingest_watermark_ms":
+            "Per-shard ingest high-water mark (ms); the results "
+            "cache's freshness horizon input",
         "filodb_grpc_rpcs_served_total": "gRPC query-service RPCs served",
         "filodb_breaker_state": "Per-peer circuit-breaker state "
                                 "(1 per peer; state label)",
@@ -858,6 +927,12 @@ class FiloHttpServer:
                           "shard": str(getattr(shard, "shard_num", ""))}
                 for f in _dc.fields(st):
                     emit(f.name, labels, getattr(st, f.name))
+                if hasattr(shard, "decode_cache_bytes"):
+                    emit("decode_cache_bytes", labels,
+                         shard.decode_cache_bytes())
+                wm = getattr(shard, "ingest_watermark_ms", None)
+                if wm is not None:
+                    emit("ingest_watermark_ms", labels, wm)
                 tracker = getattr(shard, "card_tracker", None)
                 if tracker is not None:
                     root = tracker.scan((), 0)
@@ -910,6 +985,27 @@ class FiloHttpServer:
                 pc.get("invalidations_by_reason", {}).items()):
             emit("plan_cache_invalidations_by_reason_total",
                  {"reason": reason}, n)
+        rc = self.result_cache.snapshot()
+        emit("result_cache_entries", {}, rc["entries"])
+        emit("result_cache_bytes", {}, rc["bytes"])
+        emit("result_cache_hits_total", {}, rc["hits"])
+        emit("result_cache_partial_hits_total", {}, rc["partial_hits"])
+        emit("result_cache_misses_total", {}, rc["misses"])
+        emit("result_cache_stitches_total", {}, rc["stitches"])
+        emit("result_cache_churn_recomputes_total", {},
+             rc["churn_recomputes"])
+        emit("result_cache_bypassed_total", {}, rc["bypassed"])
+        emit("result_cache_degraded_skips_total", {},
+             rc["degraded_skips"])
+        emit("result_cache_evictions_total", {}, rc["evictions"])
+        emit("result_cache_invalidations_total", {},
+             rc["invalidations"])
+        emit("result_cache_watermark_invalidations_total", {},
+             rc["watermark_invalidations"])
+        emit("result_cache_cached_steps_served_total", {},
+             rc["cached_steps_served"])
+        emit("result_cache_computed_steps_served_total", {},
+             rc["computed_steps_served"])
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)

@@ -49,7 +49,9 @@ class IngestionDriver:
                  flush_interval_s: float = 1.0,
                  poll_interval_s: float = 0.02,
                  on_event: Optional[Callable] = None,
-                 max_resident_samples: int = 0):
+                 max_resident_samples: int = 0,
+                 ingest_batch_records: int = 64,
+                 max_decode_cache_bytes: int = 0):
         self.shard = shard
         self.stream = stream
         self.mapper = mapper
@@ -59,6 +61,13 @@ class IngestionDriver:
         self.on_event = on_event or (lambda *a: None)
         # memory-pressure watermark (0 = no cap): checked after flushes
         self.max_resident_samples = max_resident_samples
+        # WAL read batch per poll (ingest-batch-records): bigger batches
+        # amortize per-poll overhead during replay at the cost of
+        # coarser flush-cadence checks between records
+        self.ingest_batch_records = max(1, int(ingest_batch_records))
+        # decode/merge-cache byte budget (0 = unbounded): trimmed on the
+        # flush path via TimeSeriesShard.trim_decode_caches
+        self.max_decode_cache_bytes = int(max_decode_cache_bytes)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._next_group = 0
@@ -120,14 +129,18 @@ class IngestionDriver:
             return
         self._set_status(ShardStatus.RECOVERY, 0)
         while self.next_offset < end and not self._stop.is_set():
-            if not self._ingest_available(limit=end - self.next_offset):
+            if not self._ingest_available(
+                    limit=min(self.ingest_batch_records,
+                              end - self.next_offset)):
                 break                            # stream shrank (shouldn't)
             done = self.next_offset - start
             pct = int(100 * done / max(1, end - start))
             self._set_status(ShardStatus.RECOVERY, min(pct, 99))
 
-    def _ingest_available(self, limit: int = 64) -> bool:
+    def _ingest_available(self, limit: Optional[int] = None) -> bool:
         """Poll + ingest one batch; returns True if anything was read."""
+        if limit is None:
+            limit = self.ingest_batch_records
         batch = self.stream.read(self.next_offset, max_records=limit)
         if not batch:
             return False
@@ -162,6 +175,8 @@ class IngestionDriver:
             self.shard.flush_group(group, offset=self.next_offset - 1)
         if self.max_resident_samples:
             self.shard.ensure_headroom(self.max_resident_samples)
+        if self.max_decode_cache_bytes:
+            self.shard.trim_decode_caches(self.max_decode_cache_bytes)
         self._records_since_flush = 0
         self._last_flush_t = time.monotonic()
 

@@ -33,6 +33,10 @@ FSYNC_BUCKETS_S = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 # batch occupancy: powers of two up to the batcher's max_batch scale
 OCCUPANCY_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+# step counts (results-cache cached-steps-served): dashboards range from
+# a handful of steps to multi-day grids
+STEPS_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 2048.0)
 
 
 def _fmt_float(v: float) -> str:

@@ -227,7 +227,8 @@ class PromQlRemoteExec:
                  local_only: bool = True,
                  retry: Optional[RetryPolicy] = None,
                  breakers: Optional[BreakerRegistry] = None,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None,
+                 no_cache: bool = False):
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -244,6 +245,9 @@ class PromQlRemoteExec:
         self.retry = retry
         self.breakers = breakers
         self.deadline = deadline
+        # the caller's &cache=false rides the hop: the peer must not
+        # serve this query from its results cache either
+        self.no_cache = no_cache
 
     def execute(self):
         import urllib.parse
@@ -262,6 +266,8 @@ class PromQlRemoteExec:
             path = "query_range"
         if self.local_only:
             qs["dispatch"] = "local"    # no fan-back-out (loop prevention)
+        if self.no_cache:
+            qs["cache"] = "false"
         qs["hist-wire"] = "1"
 
         def dial(t: float) -> Dict:

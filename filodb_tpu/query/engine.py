@@ -1280,3 +1280,82 @@ def _plan_window(plan) -> int:
     if isinstance(plan, lp.PeriodicSeries):
         return plan.lookback_ms
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Results-cache split / stitch (query/resultcache.py's evaluation core)
+#
+# The incremental range-query cache stores per-step matrix extents; a
+# sliding-window dashboard re-issue splits into the cached extent and
+# (at most) a head + tail of uncovered steps, each evaluated through the
+# NORMAL pipeline via an lp_replace_range-rebased plan — the same
+# rewrite the plan cache and the raw/downsample tier split rely on, so a
+# sub-range evaluation is exactly what a fresh parse at that range would
+# compute. Step values are per-step functions of the underlying samples
+# (windows are anchored on the step, not the grid bounds), so columns
+# computed under different grids are bit-identical and stitch losslessly.
+# ---------------------------------------------------------------------------
+
+def uncovered_spans(start_ms: int, step_ms: int, end_ms: int,
+                    cov_lo_ms: int, cov_hi_ms: int
+                    ) -> List[Tuple[int, int]]:
+    """Split a requested step range [start, end] against a covered
+    sub-range [cov_lo, cov_hi] (all step-aligned, cov within request):
+    the 0-2 contiguous spans that must be recomputed. An empty/invalid
+    coverage yields the whole request."""
+    if cov_lo_ms > cov_hi_ms:
+        return [(start_ms, end_ms)]
+    spans: List[Tuple[int, int]] = []
+    if cov_lo_ms > start_ms:
+        spans.append((start_ms, cov_lo_ms - step_ms))
+    if cov_hi_ms < end_ms:
+        spans.append((cov_hi_ms + step_ms, end_ms))
+    return spans
+
+
+def assemble_stitched(steps: np.ndarray, cached_steps: np.ndarray,
+                      cached_keys: Sequence[Mapping[str, str]],
+                      cached_values: np.ndarray,
+                      span_grids: Sequence[GridResult]
+                      ) -> Tuple[GridResult, List[Dict[str, str]]]:
+    """Assemble the full request grid from cached step columns plus
+    freshly computed span grids, matching series identity by label set.
+
+    Series keep the CACHED extent's order — selection order is stable
+    across evaluations of the same data, so a fresh full-range compute
+    enumerates the same series in the same order and the stitched
+    response is byte-identical to it. A cached series absent from a
+    computed span keeps NaN there (the span evaluation fetched back
+    through the lookback window, so absence means a fresh compute would
+    find no samples for those steps either — Prometheus staleness).
+
+    Returns (grid, churn): ``churn`` lists series present in a computed
+    span but ABSENT from the cached extent. Stitching cannot place them
+    (their values at the cached steps are unknown — e.g. a new series
+    whose backfill may even invalidate aggregated cached columns), so
+    the caller computes-through: a full-range fresh evaluation replaces
+    the stitch when churn is non-empty."""
+    T = int(steps.size)
+    key_ix = {tuple(sorted(k.items())): i
+              for i, k in enumerate(cached_keys)}
+    values = np.full((len(cached_keys), T), np.nan)
+    if cached_steps.size:
+        pos = np.searchsorted(steps, cached_steps)
+        values[:, pos] = cached_values
+    churn: List[Dict[str, str]] = []
+    out = GridResult(steps, [dict(k) for k in cached_keys], values)
+    for g in span_grids:
+        if g.is_hist():
+            # histogram grids never enter the cache; a span turning
+            # hist means the world changed under us — compute through
+            churn.append({"__hist__": "1"})
+            continue
+        gpos = np.searchsorted(steps, g.steps)
+        for i, k in enumerate(g.keys):
+            j = key_ix.get(tuple(sorted(k.items())))
+            if j is None:
+                churn.append(dict(k))
+                continue
+            values[j][gpos] = g.values[i]
+        out.absorb_degraded(g)
+    return out, churn

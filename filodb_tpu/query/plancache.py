@@ -39,6 +39,15 @@ def _cacheable(plan) -> bool:
     return _splittable(plan) and plan_range(plan) is not None
 
 
+def range_abstracted_key(dataset: str, query: str, step_ms: int) -> Tuple:
+    """The shared range-abstracted cache key: (dataset, normalized query
+    text, step). Both the plan cache and the results cache key on it —
+    dashboards re-issue the SAME text with a sliding (start, end), so
+    the range must stay out of the key (the results cache additionally
+    sub-keys on step alignment, ``start % step``)."""
+    return (dataset, query, int(step_ms))
+
+
 @guarded_by("_lock", "_entries", "hits", "misses", "uncacheable",
             "invalidations", "rebases", "invalidations_by_reason")
 class PlanCache:
@@ -57,6 +66,11 @@ class PlanCache:
         # vs explicit) — a flapping mapper shows as topology churn here
         self.invalidations_by_reason: Dict[str, int] = {}
         self.rebases = 0
+        # downstream caches keyed on the same world (the results cache)
+        # ride this cache's invalidation events: any reason that clears
+        # cached plans also clears cached results. Listeners are called
+        # OUTSIDE the lock (they take their own).
+        self._listeners: list = []
 
     @property
     def enabled(self) -> bool:
@@ -101,15 +115,23 @@ class PlanCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
 
+    def add_invalidation_listener(self, fn) -> None:
+        """Register ``fn(reason)`` to run after every invalidation —
+        the hook the results cache uses to share this cache's topology/
+        schema invalidation events."""
+        self._listeners.append(fn)
+
     def invalidate(self, reason: str = "") -> None:
         """Explicit invalidation hook: shard-topology or schema change.
-        Clears every cached plan."""
+        Clears every cached plan and notifies listeners (result cache)."""
         with self._lock:
             self._entries.clear()
             self.invalidations += 1
             key = reason or "unspecified"
             self.invalidations_by_reason[key] = \
                 self.invalidations_by_reason.get(key, 0) + 1
+        for fn in list(self._listeners):
+            fn(reason)
 
     def __len__(self) -> int:
         with self._lock:

@@ -553,7 +553,8 @@ class QueryPlanner:
                  grpc_partitions: Optional[Dict[str, str]] = None,
                  deadline: Optional[object] = None,
                  allow_partial: bool = False,
-                 resilience: Optional[object] = None):
+                 resilience: Optional[object] = None,
+                 no_result_cache: bool = False):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -601,6 +602,10 @@ class QueryPlanner:
         # outlive one query)
         self.deadline = deadline
         self.allow_partial = bool(allow_partial)
+        # &cache=false propagation: a bypassed query must stay bypassed
+        # across whole-query pushdown hops (the peer consults its OWN
+        # results cache otherwise)
+        self.no_result_cache = bool(no_result_cache)
         if resilience is None:
             from filodb_tpu.parallel.resilience import PeerResilience
             resilience = PeerResilience.default()
@@ -619,7 +624,8 @@ class QueryPlanner:
         tolerance lives in the surrounding ConcatExec, not the hop)."""
         return dict(retry=self.resilience.retry,
                     breakers=self.resilience.breakers,
-                    deadline=self.deadline)
+                    deadline=self.deadline,
+                    no_cache=self.no_result_cache)
 
     # -- shard pruning (shardsFromFilters, SingleClusterPlanner.scala:872) --
     def shards_from_filters(self, filters: Sequence[ColumnFilter]

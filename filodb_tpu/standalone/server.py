@@ -83,6 +83,20 @@ DEFAULTS = {
     "batch-max": 8,
     "batch-enabled": True,
     "plan-cache-size": 256,
+    # incremental range-query results cache (query/resultcache.py):
+    # byte budget for cached per-step matrix extents (0 disables) and
+    # the freshness hot window — steps within this many ms of now (or
+    # above a shard's ingest watermark) are never served from cache.
+    # Per-request escape hatch: &cache=false.
+    "results-cache-mb": 64,
+    "results-cache-hot-window-ms": 10_000,
+    # WAL read batch per ingest poll (was hardcoded at 64); also the
+    # recovery replay batch size
+    "ingest-batch-records": 64,
+    # host decode/merge cache byte budget per shard (0 = unbounded);
+    # trimmed on the flush path — fully-persisted partitions' decoded
+    # duplicates are released first (filodb_decode_cache_bytes gauge)
+    "decode-cache-mb": 0,
     # observability (filodb_tpu.obs): distributed tracing is OFF by
     # default (zero overhead, byte-identical responses); when enabled,
     # fresh queries sample at trace-sample-rate and finished traces land
@@ -370,6 +384,10 @@ class FiloServer:
                                                   30.0)),
             resilience=resilience,
             plan_cache_size=int(self.config.get("plan-cache-size", 256)),
+            results_cache_mb=float(
+                self.config.get("results-cache-mb", 64)),
+            results_cache_hot_window_ms=float(
+                self.config.get("results-cache-hot-window-ms", 10_000)),
             max_inflight_queries=int(self.config.get(
                 "max-inflight-queries", 4)),
             tracer=self._make_tracer(),
@@ -444,7 +462,11 @@ class FiloServer:
                 flush_interval_s=float(self.config.get("flush-interval-s",
                                                        2.0)),
                 max_resident_samples=int(
-                    self.config.get("max-resident-samples", 0)))
+                    self.config.get("max-resident-samples", 0)),
+                ingest_batch_records=int(
+                    self.config.get("ingest-batch-records", 64)),
+                max_decode_cache_bytes=int(float(
+                    self.config.get("decode-cache-mb", 0)) * (1 << 20)))
             self.drivers.append(drv.start())
         if self.config.get("gateway-port") is not None:
             from filodb_tpu.gateway.server import GatewayServer
@@ -577,7 +599,11 @@ class FiloServer:
                 flush_interval_s=float(
                     self.config.get("flush-interval-s", 2.0)),
                 max_resident_samples=int(
-                    self.config.get("max-resident-samples", 0)))
+                    self.config.get("max-resident-samples", 0)),
+                ingest_batch_records=int(
+                    self.config.get("ingest-batch-records", 64)),
+                max_decode_cache_bytes=int(float(
+                    self.config.get("decode-cache-mb", 0)) * (1 << 20)))
             self._adopted_drivers[shard] = drv.start()
         else:
             self.mapper.update(shard, ShardStatus.ACTIVE, self.node_id)

@@ -77,6 +77,11 @@ def cluster():
         "query-sample-limit": 0, "query-series-limit": 0,
         "failure-detect-interval-s": 300.0,
         "grpc-port": None,                  # deterministic HTTP plane
+        # exec-layer resilience is under test: every query must actually
+        # dial its peers, so the results cache stays out of the loop
+        # (its own degraded-result admission guard is pinned by
+        # tests/test_resultcache.py chaos scenarios)
+        "results-cache-mb": 0,
         "query-timeout-s": 8.0,
         "peer-retry-attempts": 1,           # breaker math: 1 dial/query
         "peer-retry-base-delay-s": 0.01,

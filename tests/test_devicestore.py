@@ -104,7 +104,10 @@ def test_http_second_query_zero_builds():
     _ingest(shard, 360, T0)
     shard.flush_all()
     backend = TpuBackend()
-    srv = FiloHttpServer({"timeseries": [shard]}, backend=backend, port=0)
+    # results cache off: the second query must reach the DEVICE tile
+    # cache (a results-cache hit would short-circuit above it)
+    srv = FiloHttpServer({"timeseries": [shard]}, backend=backend,
+                         port=0, results_cache_mb=0)
     srv.start()
     try:
         url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"

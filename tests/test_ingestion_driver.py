@@ -163,3 +163,53 @@ def test_recovery_idempotent_replay_below_group_checkpoints(tmp_path):
         assert np.all(np.diff(ts) > 0)            # strictly increasing
         n_rows += ts.size
     assert n_rows == total_expected
+
+
+def test_ingest_batch_records_knob_replays_equivalently():
+    """The WAL read batch (ingest-batch-records, was hardcoded at 64)
+    must not change WHAT gets ingested — tiny and huge batches deliver
+    the same rows, checkpoints, and query results."""
+    shards = {}
+    for batch in (2, 256):
+        stream = MemoryIngestionStream()
+        _publish(stream, n_batches=10, rows_per_batch=20)
+        shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                                max_chunk_rows=64)
+        drv = IngestionDriver(shard, stream, flush_every_records=3,
+                              ingest_batch_records=batch)
+        drv.start()
+        assert _wait(lambda: drv.next_offset == 10)
+        drv.stop()
+        assert shard.stats.rows_ingested == 200
+        assert shard.recovery_watermark() == 9
+        shards[batch] = shard
+    small, big = shards[2], shards[256]
+    assert small.ingest_watermark_ms == big.ingest_watermark_ms
+    want, got = _query(small), _query(big)
+    assert want.num_series == got.num_series == 2
+    wmap = {k["instance"]: want.values[i]
+            for i, k in enumerate(want.keys)}
+    for i, k in enumerate(got.keys):
+        np.testing.assert_array_equal(got.values[i],
+                                      wmap[k["instance"]])
+
+
+def test_ingest_batch_records_recovery_replay(tmp_path):
+    """Recovery replay honours the knob too: a 1-record batch replays
+    to the same state as the default."""
+    stream_path = str(tmp_path / "stream.log")
+    stream1 = LogIngestionStream(stream_path, DEFAULT_SCHEMAS)
+    _publish(stream1, n_batches=8)
+    results = []
+    for batch in (1, 64):
+        shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                                max_chunk_rows=64)
+        drv = IngestionDriver(
+            shard, LogIngestionStream(stream_path, DEFAULT_SCHEMAS),
+            flush_every_records=100, ingest_batch_records=batch)
+        drv.start()
+        assert _wait(lambda: drv.next_offset == 8)
+        drv.stop()
+        results.append((shard.stats.rows_ingested,
+                        shard.ingest_watermark_ms))
+    assert results[0] == results[1]

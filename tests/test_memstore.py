@@ -203,3 +203,61 @@ def test_concurrent_reads_during_flush_no_corruption():
     ts, vals, _ = part.read_full(1)
     assert ts.size == 600
     np.testing.assert_array_equal(vals, np.arange(600, dtype=np.float64))
+
+
+def test_ingest_watermark_tracks_max_timestamp():
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0)
+    assert shard.ingest_watermark_ms == -1
+    _ingest_series(shard, n_series=2, n_samples=10, t0=1_000_000,
+                   step=10_000)
+    assert shard.ingest_watermark_ms == 1_000_000 + 9 * 10_000
+    # OOO rows are dropped and must not move the watermark backwards
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    b.add_sample("gauge", _gauge_labels(0), 500_000, 1.0)
+    for c in b.containers():
+        shard.ingest(c)
+    assert shard.ingest_watermark_ms == 1_000_000 + 9 * 10_000
+
+
+def test_decode_cache_bytes_and_trim(tmp_path):
+    """The decode/merge caches are observable and boundable: persisted
+    partitions release their decoded duplicates under a byte budget,
+    and reads after a trim re-decode correctly."""
+    from filodb_tpu.store import FlatFileColumnStore
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=1,
+                            max_chunk_rows=32, column_store=cs)
+    _ingest_series(shard, n_series=4, n_samples=96)
+    shard.flush_all(offset=0)               # everything persisted
+    assert shard.decode_cache_bytes() == 0  # nothing read yet
+    parts = shard.lookup_partitions([], 0, 2**62)
+    before = [p.read_full(1) for p in parts]
+    used = shard.decode_cache_bytes()
+    assert used > 0
+    # over-budget: persisted partitions give their caches back
+    freed = shard.trim_decode_caches(max_bytes=1)
+    assert freed > 0
+    assert shard.decode_cache_bytes() < used
+    # under-budget: a no-op
+    assert shard.trim_decode_caches(max_bytes=1 << 30) == 0
+    # reads after the trim re-decode to identical data
+    for p, (ts, vals, chunk_len) in zip(parts, before):
+        ts2, vals2, chunk_len2 = p.read_full(1)
+        np.testing.assert_array_equal(ts, ts2)
+        np.testing.assert_array_equal(vals, vals2)
+        assert chunk_len == chunk_len2
+
+
+def test_trim_decode_caches_keeps_unpersisted_partitions():
+    """Without a column store nothing is persisted: caches are the only
+    decode of in-memory chunks' hot read path and must survive a trim."""
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=1,
+                            max_chunk_rows=32)
+    _ingest_series(shard, n_series=2, n_samples=64)
+    shard.flush_all()
+    for p in shard.lookup_partitions([], 0, 2**62):
+        p.read_full(1)
+    used = shard.decode_cache_bytes()
+    assert used > 0
+    assert shard.trim_decode_caches(max_bytes=1) == 0
+    assert shard.decode_cache_bytes() == used

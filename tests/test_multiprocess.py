@@ -194,12 +194,15 @@ def test_cross_node_query_and_peer_death(cluster):
     def _grpc_plane():
         _series_instances(p0)
         _series_instances(p1)
+        # cache=false: this probe waits for the gRPC data plane to
+        # carry a leaf dispatch — a results-cache hit would answer
+        # without dialing the peer and the poll would never converge
         _get(p0, "/promql/timeseries/api/v1/query_range",
              query="rate(http_requests_total[5m])",
-             start=T0 + 300, end=T0 + 900, step=60)
+             start=T0 + 300, end=T0 + 900, step=60, cache="false")
         _get(p1, "/promql/timeseries/api/v1/query_range",
              query="rate(http_requests_total[5m])",
-             start=T0 + 300, end=T0 + 900, step=60)
+             start=T0 + 300, end=T0 + 900, step=60, cache="false")
         served = [_grpc_rpcs(p0), _grpc_rpcs(p1)]
         return all(s > 0 for s in served), served
     _poll(_grpc_plane, timeout=30)

@@ -1,0 +1,584 @@
+"""Incremental range-query results cache (query/resultcache.py).
+
+Pins the ISSUE contract end to end: cache-off and cache-on servers
+answer fresh computes byte-identically; stitched cached responses
+exactly equal a fresh full recompute (golden vs the &cache=false bypass
+of the SAME server — same data, same pipeline, cache out of the loop);
+steps above the ingest watermark are never served from cache (new data
+appears on the next refresh); watermark regressions invalidate; series
+churn computes through; the LRU honours its byte budget; and degraded/
+partial results are provably never admitted (chaos-injected peer
+failure scenario)."""
+
+import json
+import socket
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.grpcsvc import wire
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.model import GridResult, QueryStats
+from filodb_tpu.query.resultcache import (ResultCache, result_cacheable,
+                                          shards_watermark)
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+
+
+# ---------------------------------------------------------------------------
+# unit layer: a stub engine whose "pipeline" is a deterministic function
+# of (series, step) — evaluation ranges and call counts are observable
+# ---------------------------------------------------------------------------
+
+class _StubExec:
+    def __init__(self, eng, plan):
+        self.eng = eng
+        self.plan = plan
+
+    def execute(self):
+        from filodb_tpu.query.planner import plan_range
+        start, step, end, _, _ = plan_range(self.plan)
+        self.eng.executed.append((start, step, end))
+        steps = np.arange(start, end + 1, step, dtype=np.int64)
+        keys = [{"_metric_": "up", "instance": f"i{s}"}
+                for s in range(self.eng.n_series)]
+        vals = np.array([[s * 1e6 + t / 1000.0 for t in steps]
+                         for s in range(self.eng.n_series)])
+        if not keys:
+            vals = np.zeros((0, steps.size))
+        g = GridResult(steps, keys, vals)
+        g.partial = self.eng.partial
+        return g
+
+
+class _StubEngine:
+    def __init__(self, n_series=2, shards=(), partial=False):
+        self.n_series = n_series
+        self.shards = list(shards)
+        self.stats = QueryStats()
+        self.partial = partial
+        self.executed = []
+
+    def materialize(self, plan):
+        return _StubExec(self, plan)
+
+
+class _Shard:
+    def __init__(self, wm):
+        self.ingest_watermark_ms = wm
+
+
+def _plan(start_s, step_s, end_s, q="up"):
+    return parse_query_range(q, TimeStepParams(start_s, step_s, end_s))
+
+
+def _run(rc, eng, start_s, end_s, step_s=60, q="up", bypass=False):
+    plan = _plan(start_s, step_s, end_s, q)
+    res, ses = rc.execute(eng, "ds", q, plan, start_s * 1000,
+                          step_s * 1000, end_s * 1000, bypass=bypass)
+    return res, ses
+
+
+def test_miss_then_full_hit_then_tail_only():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    res, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss" and len(eng.executed) == 1
+    full = res
+    # same range again: every step from cache, nothing executes
+    res2, ses2 = _run(rc, eng, 1000, 1600)
+    assert ses2.state == "hit"
+    assert len(eng.executed) == 1           # no new evaluation
+    assert res2.keys == full.keys
+    assert np.array_equal(res2.values, full.values, equal_nan=True)
+    # slid window: only the uncovered tail evaluates
+    res3, ses3 = _run(rc, eng, 1120, 1720)
+    assert ses3.state == "partial"
+    assert eng.executed[-1] == (1660 * 1000, 60 * 1000, 1720 * 1000)
+    fresh = _StubExec(eng, _plan(1120, 60, 1720)).execute()
+    assert np.array_equal(res3.values, fresh.values, equal_nan=True)
+    assert [dict(k) for k in res3.keys] == [dict(k) for k in fresh.keys]
+    snap = rc.snapshot()
+    assert snap["hits"] == 1 and snap["partial_hits"] == 1
+    assert snap["misses"] == 1 and snap["cached_steps_served"] > 0
+
+
+def test_head_and_tail_spans():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    _run(rc, eng, 1000, 1600)
+    # widened both ways: head AND tail evaluate, middle comes cached
+    res, ses = _run(rc, eng, 880, 1720)
+    assert ses.state == "partial"
+    assert eng.executed[-2:] == [
+        (880 * 1000, 60 * 1000, 940 * 1000),
+        (1660 * 1000, 60 * 1000, 1720 * 1000)]
+    fresh = _StubExec(eng, _plan(880, 60, 1720)).execute()
+    assert np.array_equal(res.values, fresh.values, equal_nan=True)
+
+
+def test_step_alignment_is_part_of_the_key():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    _run(rc, eng, 1000, 1600)
+    # same query/step, phase shifted by 30s: cached columns sit between
+    # this grid's steps — must NOT be reused
+    _, ses = _run(rc, eng, 1030, 1630)
+    assert ses.state == "miss"
+
+
+def test_hot_window_blocks_recent_steps():
+    now_s = 2000.0
+    rc = ResultCache(max_bytes=1 << 20, hot_window_ms=300_000,
+                     clock=lambda: now_s)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    # horizon = 2000s - 300s = 1700s: steps above 1700 never cache
+    _run(rc, eng, 1000, 1900)
+    _, ses = _run(rc, eng, 1000, 1900)
+    assert ses.state == "partial"
+    # the hot tail (1720..1900) re-evaluated despite the repeat
+    assert eng.executed[-1] == (1720 * 1000, 60 * 1000, 1900 * 1000)
+
+
+def test_watermark_caps_the_extent():
+    rc = ResultCache(max_bytes=1 << 20)
+    wm = 1300 * 1000
+    eng = _StubEngine(shards=[_Shard(wm)])
+    _run(rc, eng, 1000, 1600)
+    _, ses = _run(rc, eng, 1000, 1600)
+    # steps above the shard watermark may still receive samples: they
+    # are recomputed every refresh, only the settled prefix comes from
+    # cache. The raw 5-step tail (1360..1600) widens to the 8-step
+    # pow2 bucket (1180..1600) so the device executor's shape set stays
+    # tiny across slides — the overlap recomputes bit-identical values.
+    assert ses.state == "partial"
+    assert eng.executed[-1] == (1180 * 1000, 60 * 1000, 1600 * 1000)
+    assert ses.cached_steps == 3            # 1000..1120
+    assert ses.computed_steps == 8
+
+
+def test_watermark_regression_invalidates():
+    rc = ResultCache(max_bytes=1 << 20)
+    sh = _Shard(2000 * 1000)
+    eng = _StubEngine(shards=[sh])
+    _run(rc, eng, 1000, 1600)
+    assert len(rc) == 1
+    sh.ingest_watermark_ms = 1200 * 1000    # stream replay / re-adoption
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss"
+    assert rc.snapshot()["watermark_invalidations"] == 1
+
+
+def test_series_churn_computes_through():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(n_series=1, shards=[_Shard(10_000_000 * 1000)])
+    _run(rc, eng, 1000, 1600)
+    eng.n_series = 2                        # a new series appears
+    res, ses = _run(rc, eng, 1120, 1720)
+    assert ses.state == "churn"
+    # the full range re-evaluated (not just the tail)
+    assert eng.executed[-1] == (1120 * 1000, 60 * 1000, 1720 * 1000)
+    assert res.num_series == 2
+    # the re-seeded extent serves the new world
+    _, ses2 = _run(rc, eng, 1120, 1720)
+    assert ses2.state == "hit"
+
+
+def test_vanished_series_keeps_nan_tail():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(n_series=2, shards=[_Shard(10_000_000 * 1000)])
+    _run(rc, eng, 1000, 1600)
+    eng.n_series = 0                        # series stop reporting
+    res, ses = _run(rc, eng, 1120, 1720)
+    assert ses.state == "partial"
+    assert res.num_series == 2
+    # cached steps keep their values; the tail is stale-NaN
+    tail = res.values[:, -2:]
+    assert np.isnan(tail).all()
+
+
+def test_lru_byte_budget_eviction():
+    rc = ResultCache(max_bytes=1200)
+    eng = _StubEngine(n_series=1, shards=[_Shard(10_000_000 * 1000)])
+    # each extent: 11 steps * 8B + key/entry overhead ~= 470B -> the
+    # budget holds two; storing four must evict the oldest
+    for i in range(4):
+        _run(rc, eng, 1000, 1600, q=f"up + {i}")
+    snap = rc.snapshot()
+    assert snap["bytes"] <= 1200
+    assert snap["evictions"] >= 1
+    assert len(rc) < 4
+    # oldest key evicted, newest resident
+    _, ses = _run(rc, eng, 1000, 1600, q="up + 0")
+    assert ses.state == "miss"
+    _, ses = _run(rc, eng, 1000, 1600, q="up + 3")
+    assert ses.state == "hit"
+
+
+def test_bypass_neither_reads_nor_seeds():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    _, ses = _run(rc, eng, 1000, 1600, bypass=True)
+    assert ses.state == "bypass" and len(rc) == 0
+    _run(rc, eng, 1000, 1600)               # seed
+    _, ses = _run(rc, eng, 1000, 1600, bypass=True)
+    assert ses.state == "bypass"
+    assert eng.executed[-1] == (1000 * 1000, 60 * 1000, 1600 * 1000)
+    assert rc.snapshot()["bypassed"] == 2
+
+
+def test_degraded_results_never_admitted():
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)], partial=True)
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss" and len(rc) == 0
+    assert rc.snapshot()["degraded_skips"] == 1
+    # engine-stats warnings (dropped shard group) also block admission
+    eng2 = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    eng2.stats.warnings.append("partial result: node1 unavailable")
+    _, _ = _run(rc, eng2, 1000, 1600)
+    assert len(rc) == 0
+    assert rc.snapshot()["degraded_skips"] == 2
+    # a degraded TAIL stitches (response flagged) but must not roll the
+    # extent forward
+    eng3 = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    _run(rc, eng3, 1000, 1600)
+    stores0 = rc.snapshot()["stores"]
+    eng3.partial = True
+    res, ses = _run(rc, eng3, 1120, 1720)
+    assert ses.state == "partial" and res.partial
+    assert rc.snapshot()["stores"] == stores0
+
+
+def test_uncacheable_shapes():
+    rc = ResultCache(max_bytes=1 << 20)
+    assert not result_cacheable(_plan(
+        1000, 60, 1600, "rate(up[5m] @ 1500)"))
+    assert not result_cacheable(_plan(
+        1000, 60, 1600, "max_over_time(rate(up[1m])[10m:1m])"))
+    # sort()/limit order by range, not per step: extents can't reuse
+    assert not result_cacheable(_plan(1000, 60, 1600, "sort(up)"))
+    assert result_cacheable(_plan(1000, 60, 1600,
+                                  "sum(rate(up[5m])) by (instance)"))
+    eng = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    _, ses = _run(rc, eng, 1000, 1600, q="sort(up)")
+    assert ses.state == "uncacheable" and len(rc) == 0
+
+
+def test_watermark_helper_ignores_empty_shards():
+    assert shards_watermark([]) is None
+    assert shards_watermark([object()]) is None
+    assert shards_watermark([_Shard(-1)]) is None
+    assert shards_watermark([_Shard(5000), _Shard(-1)]) == 5000
+    assert shards_watermark([_Shard(5000), _Shard(3000)]) == 3000
+
+
+def test_exec_request_no_cache_roundtrip():
+    buf = wire.encode_exec_request("ds", "up", 1000, 60, 2000,
+                                   no_cache=True)
+    assert wire.decode_exec_request(buf)["no_cache"] is True
+    buf = wire.encode_exec_request("ds", "up", 1000, 60, 2000)
+    assert wire.decode_exec_request(buf)["no_cache"] is False
+
+
+# ---------------------------------------------------------------------------
+# server layer: end-to-end over the HTTP edge
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def servers():
+    cached = FiloServer({"num-shards": 4, "port": 0}).start()
+    cached.seed_dev_data(n_samples=360, n_instances=4,
+                         start_ms=T0 * 1000)
+    plain = FiloServer({"num-shards": 4, "port": 0,
+                        "results-cache-mb": 0}).start()
+    plain.seed_dev_data(n_samples=360, n_instances=4,
+                        start_ms=T0 * 1000)
+    yield cached, plain
+    cached.stop()
+    plain.stop()
+
+
+def _get_json(server, path="/promql/timeseries/api/v1/query_range",
+              **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{server.port}{path}?{qs}"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+QUERIES = [
+    "rate(http_requests_total[5m])",
+    "sum(rate(http_requests_total[5m])) by (instance)",
+    "avg_over_time(heap_usage[10m])",
+    "max(heap_usage) by (instance)",
+]
+
+
+def test_cache_on_vs_cache_off_byte_identical(servers):
+    """Fresh computes (first sight of each text) and stitched re-issues
+    alike must match the cache-disabled server exactly — the response
+    DATA is compared verbatim (exact float strings), only the wall-clock
+    timings block and scan stats legitimately differ."""
+    cached, plain = servers
+    dispositions = []
+    for q in QUERIES:
+        for k in range(4):          # sliding window per text
+            start = T0 + 600 + k * 60
+            end = start + 900
+            _, jc = _get_json(cached, query=q, start=start, end=end,
+                              step=60)
+            _, jp = _get_json(plain, query=q, start=start, end=end,
+                              step=60)
+            dispositions.append(
+                jc["stats"]["timings"]["resultCache"])
+            assert jp["stats"]["timings"]["resultCache"] == "off"
+            assert jc["data"] == jp["data"], (q, start, end)
+    assert "miss" in dispositions and "partial" in dispositions
+
+
+def test_full_hit_serves_without_selection(servers):
+    cached, _ = servers
+    q = QUERIES[1]
+    start, end = T0 + 600, T0 + 1500
+    _get_json(cached, query=q, start=start, end=end, step=60)
+    _, body = _get_json(cached, query=q, start=start, end=end, step=60)
+    assert body["stats"]["timings"]["resultCache"] == "hit"
+    assert body["stats"]["timings"]["plan"] == "ResultCacheHit"
+    # nothing was selected/scanned for a full hit
+    assert body["stats"]["seriesScanned"] == 0
+    assert body["stats"]["samplesScanned"] == 0
+
+
+def test_cache_false_escape_hatch(servers):
+    cached, plain = servers
+    q = QUERIES[0]
+    start, end = T0 + 700, T0 + 1600
+    _get_json(cached, query=q, start=start, end=end, step=60)
+    snap0 = cached.http.result_cache.snapshot()
+    _, body = _get_json(cached, query=q, start=start, end=end, step=60,
+                        cache="false")
+    assert body["stats"]["timings"]["resultCache"] == "bypass"
+    snap1 = cached.http.result_cache.snapshot()
+    assert snap1["bypassed"] == snap0["bypassed"] + 1
+    assert snap1["stores"] == snap0["stores"]
+    # bypassed response still exactly matches the cache-off server
+    _, jp = _get_json(plain, query=q, start=start, end=end, step=60,
+                      cache="false")
+    assert body["data"] == jp["data"]
+
+
+def test_instant_queries_skip_the_cache(servers):
+    cached, _ = servers
+    snap0 = cached.http.result_cache.snapshot()
+    _get_json(cached, path="/promql/timeseries/api/v1/query",
+              query="max(heap_usage) by (instance)", time=T0 + 900)
+    snap1 = cached.http.result_cache.snapshot()
+    assert snap1["stores"] == snap0["stores"]
+
+
+def test_metrics_exposition_has_cache_families(servers):
+    cached, _ = servers
+    url = f"http://127.0.0.1:{cached.port}/metrics"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        body = r.read().decode()
+    for fam in ("filodb_result_cache_hits_total",
+                "filodb_result_cache_partial_hits_total",
+                "filodb_result_cache_bytes",
+                "filodb_result_cache_cached_steps_served_total",
+                "filodb_decode_cache_bytes",
+                "filodb_ingest_watermark_ms",
+                "filodb_resultcache_cached_steps_bucket"):
+        assert fam in body, fam
+
+
+def test_explain_trace_carries_disposition(servers):
+    cached, _ = servers
+    q = QUERIES[2]
+    start, end = T0 + 600, T0 + 1500
+    _get_json(cached, query=q, start=start, end=end, step=60)
+    _, body = _get_json(cached, query=q, start=start, end=end, step=60,
+                        explain="trace")
+    spans = body["trace"]["spans"]
+    ex = [s for s in spans if s["name"] == "execute"]
+    assert ex and ex[0]["tags"]["result_cache"] in ("hit", "partial")
+    assert "cached_steps" in ex[0]["tags"]
+
+
+# -- freshness: new samples appear despite the cache ----------------------
+
+@pytest.fixture
+def fresh_srv():
+    srv = FiloServer({"num-shards": 4, "port": 0}).start()
+    srv.seed_dev_data(n_samples=60, n_instances=4, start_ms=T0 * 1000)
+    yield srv
+    srv.stop()
+
+
+def _ingest_gauge(srv, metric, instance, t_lo, t_hi, value):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(t_lo, t_hi):
+        b.add_sample("gauge", {"_metric_": metric, "instance": instance},
+                     (T0 + t * 10) * 1000, float(value))
+    for c in b.containers():
+        srv.store.ingest(srv.ref, 0, c)
+
+
+def test_ingest_watermark_freshness(fresh_srv):
+    """Steps above the watermark are recomputed every refresh: data
+    ingested between two identical queries shows up in the second —
+    the cached prefix never masks it."""
+    srv = fresh_srv
+    _ingest_gauge(srv, "fresh_gauge", "i0", 0, 60, 1.0)
+    q = "avg_over_time(fresh_gauge[5m])"
+    start, end = T0 + 300, T0 + 900         # data ends at T0+590
+    _, first = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert first["stats"]["timings"]["resultCache"] == "miss"
+    _, again = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert again["stats"]["timings"]["resultCache"] == "partial"
+    assert again["data"] == first["data"]
+    # new samples (a different level) land beyond the old watermark:
+    # the averages at steps above T0+590 must move
+    _ingest_gauge(srv, "fresh_gauge", "i0", 60, 90, 5.0)
+    _, after = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert after["data"] != first["data"]
+    # golden: exactly what a cache-bypassing fresh compute sees
+    _, golden = _get_json(srv, query=q, start=start, end=end, step=60,
+                          cache="false")
+    assert after["data"] == golden["data"]
+
+
+def test_server_watermark_regression_invalidates(fresh_srv):
+    srv = fresh_srv
+    q = "rate(http_requests_total[5m])"
+    start, end = T0 + 300, T0 + 580
+    _get_json(srv, query=q, start=start, end=end, step=60)
+    _, hit = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert hit["stats"]["timings"]["resultCache"] == "hit"
+    # a replaying/re-adopted shard reports a LOWER watermark
+    shard = srv.store.shards(srv.ref)[0]
+    shard.ingest_watermark_ms = (T0 + 100) * 1000
+    _, body = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert body["stats"]["timings"]["resultCache"] == "miss"
+    assert srv.http.result_cache.snapshot()[
+        "watermark_invalidations"] >= 1
+
+
+def test_server_series_churn_recomputes(fresh_srv):
+    """A brand-new series landing inside the tail window forces a
+    compute-through; the response equals a fresh full compute."""
+    srv = fresh_srv
+    q = "rate(reqs_total[5m])"
+    start, end = T0 + 300, T0 + 900
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(0, 60):
+        b.add_sample("prom-counter", {"_metric_": "reqs_total",
+                                      "instance": "i0"},
+                     (T0 + t * 10) * 1000, float(t))
+    for c in b.containers():
+        srv.store.ingest(srv.ref, 0, c)
+    _, first = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert first["stats"]["timings"]["resultCache"] == "miss"
+    # second series appears, samples still inside the tail's lookback
+    b2 = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(55, 65):
+        b2.add_sample("prom-counter", {"_metric_": "reqs_total",
+                                       "instance": "i1"},
+                      (T0 + t * 10) * 1000, float(t))
+    for c in b2.containers():
+        srv.store.ingest(srv.ref, 0, c)
+    _, after = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert after["stats"]["timings"]["resultCache"] in ("partial",
+                                                        "churn")
+    _, golden = _get_json(srv, query=q, start=start, end=end, step=60,
+                          cache="false")
+    assert after["data"] == golden["data"]
+    metrics = {tuple(sorted(r["metric"].items()))
+               for r in after["data"]["result"]}
+    assert len(metrics) == 2
+    assert srv.http.result_cache.snapshot()["churn_recomputes"] >= 1
+
+
+def test_topology_change_invalidates(fresh_srv):
+    from filodb_tpu.parallel.shardmapper import ShardStatus
+    srv = fresh_srv
+    q = "avg_over_time(heap_usage[10m])"
+    _get_json(srv, query=q, start=T0 + 300, end=T0 + 580, step=60)
+    assert len(srv.http.result_cache) > 0
+    srv.mapper.update(0, ShardStatus.DOWN, srv.node_id)
+    assert len(srv.http.result_cache) == 0
+    assert srv.http.result_cache.snapshot()["invalidations"] >= 1
+    srv.mapper.update(0, ShardStatus.ACTIVE, srv.node_id)
+
+
+# ---------------------------------------------------------------------------
+# chaos: degraded/partial results are provably never cached
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_chaos_degraded_results_never_cached():
+    """Injected peer failure -> allow_partial response -> the next
+    un-degraded query must not see cached degraded steps (it recomputes
+    and returns the FULL series set)."""
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": 300.0,
+        "grpc-port": None, "query-timeout-s": 8.0,
+        "peer-retry-attempts": 1, "peer-retry-base-delay-s": 0.01,
+        "breaker-failure-threshold": 100,
+    }
+    a = FiloServer({**base, "node-ordinal": 0, "port": p0}).start()
+    a.seed_dev_data(n_samples=60, n_instances=4, start_ms=T0 * 1000)
+    b = FiloServer({**base, "node-ordinal": 1, "port": p1}).start()
+    b.seed_dev_data(n_samples=60, n_instances=4, start_ms=T0 * 1000)
+    try:
+        q = ('rate({_metric_=~"heap_usage|http_requests_total"}[5m])')
+        args = dict(query=q, start=T0 + 300, end=T0 + 580, step=60)
+        inj = chaos.ChaosInjector()
+        inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+        with inj:
+            _, degraded = _get_json(a, allow_partial="true", **args)
+        assert degraded.get("partial") is True
+        rc = a.http.result_cache.snapshot()
+        assert rc["degraded_skips"] >= 1
+        assert rc["stores"] == 0 and rc["entries"] == 0
+        deg_series = {tuple(sorted(r["metric"].items()))
+                      for r in degraded["data"]["result"]}
+        # chaos healed: the SAME query must recompute (nothing cached)
+        # and see the full series set again
+        _, healed = _get_json(a, **args)
+        assert healed["stats"]["timings"]["resultCache"] == "miss"
+        assert "partial" not in healed
+        full_series = {tuple(sorted(r["metric"].items()))
+                       for r in healed["data"]["result"]}
+        assert deg_series < full_series
+        # ...and only the clean result was admitted
+        _, hit = _get_json(a, **args)
+        assert hit["stats"]["timings"]["resultCache"] == "hit"
+        assert {tuple(sorted(r["metric"].items()))
+                for r in hit["data"]["result"]} == full_series
+    finally:
+        chaos.uninstall()
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass

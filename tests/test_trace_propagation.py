@@ -67,6 +67,10 @@ def cluster():
         "grpc-peers": grpc_peers,
         "query-sample-limit": 0, "query-series-limit": 0,
         "failure-detect-interval-s": 300.0,
+        # traces must capture the FULL pipeline (select/eval/peer hops)
+        # on every request — a results-cache hit would short-circuit the
+        # spans (and the scan stats) these tests pin
+        "results-cache-mb": 0,
         "query-timeout-s": 8.0,
         "peer-retry-attempts": 3,
         "peer-retry-base-delay-s": 0.01,
