@@ -534,13 +534,24 @@ class TimeSeriesShard:
         # per-group ingestion checkpoint offsets (CheckpointTable semantics)
         self.checkpoints: Dict[int, int] = {}
         self._resident = 0      # running resident-sample count
-        # high-water mark of ingested sample timestamps (ms); -1 until
-        # the first row lands. The results cache's freshness horizon:
-        # steps at/below the watermark are settled (the per-partition
-        # OOO guard drops older rows), steps above it may still fill in.
-        # A REGRESSION (new shard object replaying, adoption) signals
+        # settled-time lower bound (ms); -1 until the first row lands.
+        # This is the MIN over per-partition last timestamps (ODP shells
+        # contribute their persisted end time): the per-partition OOO
+        # guard drops rows <= its own last, so no partition already in
+        # the min-set can ever ingest at/below this watermark — steps
+        # at/below it are settled, steps above it may still fill in
+        # (a lagging series sits below faster ones and pins the min).
+        # The results cache uses it as the freshness horizon; a
+        # REGRESSION (new shard object replaying, adoption) signals
         # cached results built against this shard must be invalidated.
         self.ingest_watermark_ms = -1
+        # monotone count of backfill events: a partition ENTERING the
+        # min-set (new series, re-created series, shell without a
+        # persisted end) whose first accepted row lands at/below the
+        # watermark. Such rows dirty already-settled steps without
+        # moving the watermark (the entrant's LAST may sit above it),
+        # so the results cache invalidates on any epoch change.
+        self.ingest_backfill_epoch = 0
         # serializes ODP page-ins (queries arrive from concurrent HTTP
         # threads; page-in rebinds part.chunks — everything else on the
         # read path sees immutable snapshots and needs no lock)
@@ -594,6 +605,7 @@ class TimeSeriesShard:
         batched buffer extension instead of a per-row Python loop."""
         n = 0
         tss, cols = container.arrays()
+        wm_recompute = False
         for i, j, pk in container.runs():
             part = self.get_or_create_partition(pk, tss[i])
             if part is None:
@@ -617,6 +629,7 @@ class TimeSeriesShard:
                     # unsorted replay run may lead with a fresh row while
                     # later rows still overlap persisted history
                     self._ensure_loaded(part)
+            prev_last = part.last_timestamp
             got = part.ingest_batch(tss[i:j], [c[i:j] for c in cols])
             if got:
                 n += got
@@ -624,9 +637,30 @@ class TimeSeriesShard:
                 last = part.last_timestamp
                 if last is not None:
                     self.index.update_end_time(part.part_id, last)
-                    if last > self.ingest_watermark_ms:
-                        self.ingest_watermark_ms = int(last)
+                    if prev_last is None:
+                        # partition enters the min-set: its last joins
+                        # the min directly; a first row at/below the
+                        # watermark is a BACKFILL into settled time
+                        # (the run min, not the last — an entrant
+                        # spanning the watermark still dirties the
+                        # steps its early rows land on)
+                        if self.ingest_watermark_ms >= 0:
+                            if int(tss[i:j].min()) \
+                                    <= self.ingest_watermark_ms:
+                                self.ingest_backfill_epoch += 1
+                            if last < self.ingest_watermark_ms:
+                                self.ingest_watermark_ms = int(last)
+                        else:
+                            # first contribution ever (or only shells
+                            # so far): fold in everything once
+                            wm_recompute = True
+                    elif prev_last <= self.ingest_watermark_ms:
+                        # the min-set's laggard advanced: the min may
+                        # rise — recompute once per container
+                        wm_recompute = True
             self.stats.out_of_order_dropped += (j - i) - got
+        if wm_recompute:
+            self.ingest_watermark_ms = self._compute_watermark()
         self.stats.rows_ingested += n
         if offset >= 0:
             # conservative: record offset against all groups on explicit flush
@@ -693,6 +727,26 @@ class TimeSeriesShard:
             return -1
         return min(self.checkpoints.values())
 
+    def _compute_watermark(self) -> int:
+        """Exact settled-time bound: min over per-partition last
+        timestamps. Evicted/bootstrapped ODP shells (in-memory chunks
+        gone, ``last_timestamp`` None) contribute their persisted index
+        end time — the page-in + OOO path guarantees a shell never
+        re-ingests at/below it. Partitions that never ingested
+        constrain nothing. O(partitions); runs on the ingest thread
+        only when the min-set's laggard advanced (or membership
+        changed), never per row."""
+        lo = None
+        for pid, p in self.partitions.items():
+            t = p.last_timestamp
+            if t is None and p.odp_pending:
+                t = self.index.end_time(pid)
+                if t == END_TIME_INGESTING:
+                    t = None
+            if t is not None and (lo is None or t < lo):
+                lo = int(t)
+        return -1 if lo is None else lo
+
     # -- persistence / recovery -------------------------------------------
     def bootstrap_from_store(self) -> int:
         """Rebuild the tag index + partition shells from persisted partkeys
@@ -715,6 +769,8 @@ class TimeSeriesShard:
         self.checkpoints = dict(self.column_store.read_checkpoints(
             self.ref.dataset, self.shard_num))
         self.stats.partitions_bootstrapped += n
+        # shells joined the min-set via their persisted end times
+        self.ingest_watermark_ms = self._compute_watermark()
         return n
 
     def _ensure_loaded(self, part: TimeSeriesPartition) -> None:
@@ -915,6 +971,11 @@ class TimeSeriesShard:
             self.index.remove_part_keys(evict)
             self.stats.num_series = len(self.partitions)
         self.stats.partitions_evicted += len(evict)
+        if evict:
+            # ODP shells swap a live last for an equal persisted end
+            # (min unchanged); dropped series LEAVE the min-set and the
+            # min may rise — recompute either way (eviction is rare)
+            self.ingest_watermark_ms = self._compute_watermark()
         return len(evict)
 
 

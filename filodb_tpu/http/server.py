@@ -450,7 +450,8 @@ class FiloHttpServer:
                             local_partitions=self.local_partitions,
                             dataset=ds,
                             grpc_peers=grpc_peers,
-                            grpc_partitions=grpc_partitions)
+                            grpc_partitions=grpc_partitions,
+                            local_dispatch=local_dispatch)
 
     def invalidate_plan_cache(self, reason: str = "schema") -> None:
         """Explicit plan-cache invalidation hook. Topology changes flow
@@ -867,6 +868,9 @@ class FiloHttpServer:
         "filodb_result_cache_watermark_invalidations_total":
             "Extents dropped on ingest-watermark regression "
             "(replay/recovery)",
+        "filodb_result_cache_backfill_invalidations_total":
+            "Extents dropped on shard backfill-epoch change (a new "
+            "series ingested below the watermark)",
         "filodb_result_cache_cached_steps_served_total":
             "Steps served from cached extents",
         "filodb_result_cache_computed_steps_served_total":
@@ -875,8 +879,9 @@ class FiloHttpServer:
             "Per-shard decode/merge cache bytes (bounded by "
             "decode-cache-mb)",
         "filodb_ingest_watermark_ms":
-            "Per-shard ingest high-water mark (ms); the results "
-            "cache's freshness horizon input",
+            "Per-shard settled-time bound (ms): min over per-"
+            "partition last timestamps; the results cache's "
+            "freshness horizon input",
         "filodb_grpc_rpcs_served_total": "gRPC query-service RPCs served",
         "filodb_breaker_state": "Per-peer circuit-breaker state "
                                 "(1 per peer; state label)",
@@ -1002,6 +1007,8 @@ class FiloHttpServer:
              rc["invalidations"])
         emit("result_cache_watermark_invalidations_total", {},
              rc["watermark_invalidations"])
+        emit("result_cache_backfill_invalidations_total", {},
+             rc["backfill_invalidations"])
         emit("result_cache_cached_steps_served_total", {},
              rc["cached_steps_served"])
         emit("result_cache_computed_steps_served_total", {},

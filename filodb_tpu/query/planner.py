@@ -554,7 +554,8 @@ class QueryPlanner:
                  deadline: Optional[object] = None,
                  allow_partial: bool = False,
                  resilience: Optional[object] = None,
-                 no_result_cache: bool = False):
+                 no_result_cache: bool = False,
+                 local_dispatch: bool = False):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -606,6 +607,12 @@ class QueryPlanner:
         # across whole-query pushdown hops (the peer consults its OWN
         # results cache otherwise)
         self.no_result_cache = bool(no_result_cache)
+        # dispatch scope: True when this planner is pinned to local
+        # shards (&dispatch=local pushdown hop / gRPC local_only). A
+        # local-only evaluation sees a SUBSET of the world a fan-out
+        # query sees — the results cache keys on this so the two can
+        # never serve each other's extents
+        self.local_dispatch = bool(local_dispatch)
         if resilience is None:
             from filodb_tpu.parallel.resilience import PeerResilience
             resilience = PeerResilience.default()

@@ -25,11 +25,22 @@ encode over the whole range. This module is the Cortex/Thanos/Mimir
   to a fresh full-range compute.
 
 * **Freshness horizon**: steps newer than the shards' min ingest
-  watermark — or within ``hot_window_ms`` of the wall clock — are never
-  served from (or admitted to) the cache; they may still receive
+  watermark (itself the MIN over per-partition last timestamps — the
+  per-partition OOO guard means no known series can ever ingest
+  at/below it) — or within ``hot_window_ms`` of the wall clock — are
+  never served from (or admitted to) the cache; they may still receive
   samples. A watermark **regression** (stream replay, shard adoption/
-  recovery) invalidates the overlapping extent: the replayed world may
-  differ from the one the extent was computed against.
+  recovery — including a watermark appearing where an extent saw none)
+  invalidates the overlapping extent, and a shard **backfill epoch**
+  bump (a new/re-created series whose first rows land at/below the
+  watermark, dirtying already-settled steps without moving the min)
+  invalidates on lookup: the replayed/backfilled world may differ from
+  the one the extent was computed against.
+
+* **Dispatch scope is part of the key**: a ``dispatch=local`` /
+  gRPC ``local_only`` evaluation (the pushdown loop-prevention hop)
+  sees only this node's shards — its extents and a full fan-out
+  query's extents live under distinct keys and never serve each other.
 
 * **Series churn**: a computed span containing a series the cached
   extent has never seen cannot be stitched (its cached-step columns are
@@ -97,16 +108,36 @@ def shards_watermark(shards: Sequence[object]) -> Optional[int]:
     """Freshness input: min ingest watermark over the engine's local
     shards that HAVE ingested, or None when none exposes one (pure
     remote dispatch / all-empty — only the hot window bounds staleness
-    then, the Cortex frontend's max-freshness trade). Never-ingested
-    shards (-1) constrain nothing; the moment one starts ingesting, its
-    (low) watermark drags the min down and the per-extent REGRESSION
-    check drops overlapping extents — so late backfill into a
-    previously empty shard invalidates instead of serving stale."""
+    then, the Cortex frontend's max-freshness trade). Each shard's
+    watermark is itself the min over its per-partition last timestamps
+    (memstore), so no already-known series anywhere can ingest at or
+    below the result; backfill by NEW series rides the shard's
+    ingest_backfill_epoch instead (see :func:`shards_epoch`).
+    Never-ingested shards (-1) constrain nothing; the moment one starts
+    ingesting, the per-extent REGRESSION check (which also fires when a
+    watermark appears where the extent recorded none) drops overlapping
+    extents — so late backfill into a previously empty shard
+    invalidates instead of serving stale. Remote shards behind a
+    fan-out planner are invisible here: their staleness is bounded only
+    by the hot window, and their scope is fenced off by the dispatch-
+    scope key component."""
     wms = [getattr(s, "ingest_watermark_ms", None) for s in shards]
     wms = [w for w in wms if w is not None and w >= 0]
     if not wms:
         return None
     return int(min(wms))
+
+
+def shards_epoch(shards: Sequence[object]) -> int:
+    """Sum of the local shards' backfill epochs. A per-partition OOO
+    guard cannot stop a NEW (or re-created/evicted-then-dropped) series
+    from ingesting below the shard watermark; the shard bumps its
+    epoch on any such entrance, and extents recorded under a different
+    epoch are dropped on lookup (the backfilled steps were cached as
+    settled). Monotone under bumps; a changed sum of any kind (shard
+    replacement resets to 0) reads as invalidation."""
+    return sum(int(getattr(s, "ingest_backfill_epoch", 0) or 0)
+               for s in shards)
 
 
 def _pow2_spans(spans: List[Tuple[int, int]], start_ms: int,
@@ -144,11 +175,11 @@ class CachedExtent:
     out column views, never copies of the whole matrix."""
 
     __slots__ = ("start_ms", "end_ms", "step_ms", "keys", "values",
-                 "watermark_ms", "nbytes", "encode_memo")
+                 "watermark_ms", "epoch", "nbytes", "encode_memo")
 
     def __init__(self, start_ms: int, end_ms: int, step_ms: int,
                  keys: List[Dict[str, str]], values: np.ndarray,
-                 watermark_ms: Optional[int]):
+                 watermark_ms: Optional[int], epoch: int = 0):
         self.start_ms = int(start_ms)
         self.end_ms = int(end_ms)
         self.step_ms = int(step_ms)
@@ -156,6 +187,7 @@ class CachedExtent:
         values.setflags(write=False)
         self.values = values
         self.watermark_ms = watermark_ms
+        self.epoch = int(epoch)     # shards' backfill-epoch sum at build
         self.nbytes = int(values.nbytes) + _KEY_OVERHEAD * len(keys) + 256
         # (start_ms, end_ms) -> rendered JSON result rows: repeat FULL
         # hits splice pre-encoded bytes (prom_json.matrix_bytes
@@ -208,13 +240,14 @@ class RangeSession:
     __slots__ = ("cache", "state", "plans", "key", "dataset", "query",
                  "start_ms", "step_ms", "end_ms", "full_plan",
                  "cached_steps", "computed_steps", "horizon_ms",
-                 "watermark_ms", "_extent", "_cov")
+                 "watermark_ms", "epoch", "_extent", "_cov")
 
     def __init__(self, cache: "ResultCache", state: str, plans: List,
                  full_plan, key, dataset: str, query: str,
                  start_ms: int, step_ms: int, end_ms: int,
                  horizon_ms: int = -1,
                  watermark_ms: Optional[int] = None,
+                 epoch: int = 0,
                  extent: Optional[CachedExtent] = None,
                  cov: Optional[Tuple[int, int]] = None,
                  cached_steps: int = 0, computed_steps: int = 0):
@@ -230,6 +263,7 @@ class RangeSession:
         self.end_ms = end_ms
         self.horizon_ms = horizon_ms
         self.watermark_ms = watermark_ms
+        self.epoch = epoch
         self._extent = extent
         self._cov = cov
         self.cached_steps = cached_steps
@@ -317,17 +351,18 @@ class RangeSession:
             return
         self.cache._store(self.key, res, self.start_ms, self.step_ms,
                           self.end_ms, self.horizon_ms,
-                          self.watermark_ms)
+                          self.watermark_ms, self.epoch)
 
 
 @guarded_by("_lock", "_entries", "_bytes", "hits", "partial_hits",
             "misses", "stitches", "churn_recomputes", "bypassed",
             "uncacheable", "stores", "evictions", "degraded_skips",
             "invalidations", "watermark_invalidations",
+            "backfill_invalidations",
             "cached_steps_served", "computed_steps_served")
 class ResultCache:
     """Byte-accounted LRU of :class:`CachedExtent`, keyed
-    ``(dataset, query, step, start % step)``.
+    ``(dataset, query, step, start % step, local_dispatch)``.
 
     Concurrency: HTTP handler threads look up and store concurrently
     while topology/schema events and watermark regressions invalidate;
@@ -357,6 +392,7 @@ class ResultCache:
         self.degraded_skips = 0     # partial/warning results refused
         self.invalidations = 0
         self.watermark_invalidations = 0
+        self.backfill_invalidations = 0     # epoch-change drops
         self.cached_steps_served = 0
         self.computed_steps_served = 0
 
@@ -385,18 +421,24 @@ class ResultCache:
                 self.uncacheable += 1
             return mk(self, "uncacheable", [plan], plan, None, dataset,
                       query, start_ms, step_ms, end_ms)
-        wm = shards_watermark(getattr(engine, "shards", ()))
+        shards = getattr(engine, "shards", ())
+        wm = shards_watermark(shards)
+        ep = shards_epoch(shards)
         now_ms = int(self._clock() * 1000)
         horizon = now_ms - int(self.hot_window_ms)
         if wm is not None:
             horizon = min(horizon, wm)
+        # dispatch scope rides the key: a local-only hop (pushdown loop
+        # prevention) evaluates a subset of the fan-out world — the two
+        # must never share extents
         key = range_abstracted_key(dataset, query, step_ms) \
-            + (int(start_ms) % int(step_ms),)
+            + (int(start_ms) % int(step_ms),
+               bool(getattr(engine, "local_dispatch", False)))
         n_steps = (end_ms - start_ms) // step_ms + 1
         # the grid's LAST step — coverage and span math run on the step
         # grid, not the raw end (which need not be step-aligned)
         grid_end = start_ms + (n_steps - 1) * step_ms
-        ext = self._lookup(key, wm)
+        ext = self._lookup(key, wm, ep)
         # floor the horizon onto this request's step grid
         hz_hi = start_ms + ((horizon - start_ms) // step_ms) * step_ms \
             if horizon >= start_ms else start_ms - step_ms
@@ -409,7 +451,8 @@ class ResultCache:
         if cov is None:
             return mk(self, "miss", [plan], plan, key, dataset, query,
                       start_ms, step_ms, end_ms, horizon_ms=horizon,
-                      watermark_ms=wm, computed_steps=n_steps)
+                      watermark_ms=wm, epoch=ep,
+                      computed_steps=n_steps)
         from filodb_tpu.query.engine import (lp_replace_range,
                                              uncovered_spans)
         spans = _pow2_spans(
@@ -421,8 +464,9 @@ class ResultCache:
         computed = sum((hi - lo) // step_ms + 1 for lo, hi in spans)
         return mk(self, "hit" if not spans else "partial", sub_plans,
                   plan, key, dataset, query, start_ms, step_ms, end_ms,
-                  horizon_ms=horizon, watermark_ms=wm, extent=ext,
-                  cov=cov, cached_steps=n_steps - computed,
+                  horizon_ms=horizon, watermark_ms=wm, epoch=ep,
+                  extent=ext, cov=cov,
+                  cached_steps=n_steps - computed,
                   computed_steps=computed)
 
     def execute(self, engine, dataset: str, query: str, plan,
@@ -437,27 +481,39 @@ class ResultCache:
         return ses.finish(engine, grids), ses
 
     # -- internals --------------------------------------------------------
-    def _lookup(self, key, wm: Optional[int]) -> Optional[CachedExtent]:
+    def _lookup(self, key, wm: Optional[int],
+                epoch: int) -> Optional[CachedExtent]:
         with self._lock:
             ext = self._entries.get(key)
             if ext is None:
                 return None
-            if wm is not None and ext.watermark_ms is not None \
-                    and wm < ext.watermark_ms:
+            if wm is not None and (ext.watermark_ms is None
+                                   or wm < ext.watermark_ms):
                 # watermark regression: the stream replayed / the shard
                 # was re-adopted below the extent's build point — the
                 # overlapping extent may describe a world that no
-                # longer exists
+                # longer exists. A watermark APPEARING where the extent
+                # recorded none is the same event: the empty world the
+                # extent was computed against has since ingested
+                # (possibly backfill below every cached step)
                 self._bytes -= ext.nbytes
                 del self._entries[key]
                 self.watermark_invalidations += 1
+                return None
+            if epoch != ext.epoch:
+                # a series entered a shard below its watermark since
+                # this extent was built: steps the extent holds as
+                # settled may now have samples the cached columns miss
+                self._bytes -= ext.nbytes
+                del self._entries[key]
+                self.backfill_invalidations += 1
                 return None
             self._entries.move_to_end(key)
             return ext
 
     def _store(self, key, grid: GridResult, start_ms: int, step_ms: int,
                end_ms: int, horizon_ms: int,
-               watermark_ms: Optional[int]) -> None:
+               watermark_ms: Optional[int], epoch: int = 0) -> None:
         if key is None:
             return
         steps = grid.steps
@@ -469,7 +525,7 @@ class ResultCache:
         values = np.array(grid.values[:, :hi])      # own the memory
         ext = CachedExtent(int(steps[0]), int(steps[hi - 1]), step_ms,
                            [dict(k) for k in grid.keys], values,
-                           watermark_ms)
+                           watermark_ms, epoch)
         if ext.nbytes > self.max_bytes:
             return              # larger than the whole budget
         with self._lock:
@@ -558,6 +614,8 @@ class ResultCache:
                 "invalidations": self.invalidations,
                 "watermark_invalidations":
                     self.watermark_invalidations,
+                "backfill_invalidations":
+                    self.backfill_invalidations,
                 "cached_steps_served": self.cached_steps_served,
                 "computed_steps_served": self.computed_steps_served,
             }

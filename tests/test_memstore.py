@@ -205,7 +205,18 @@ def test_concurrent_reads_during_flush_no_corruption():
     np.testing.assert_array_equal(vals, np.arange(600, dtype=np.float64))
 
 
-def test_ingest_watermark_tracks_max_timestamp():
+def _one(shard, labels, ts_ms, val=1.0):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    b.add_sample("gauge", labels, ts_ms, val)
+    for c in b.containers():
+        shard.ingest(c)
+
+
+def test_ingest_watermark_is_min_over_partitions():
+    """The watermark is a SETTLED-time bound: min over per-partition
+    last timestamps. The OOO guard is per-partition, so a lagging
+    series can still ingest far below the freshest series' last — the
+    max would claim those steps settled, the min never does."""
     shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0)
     assert shard.ingest_watermark_ms == -1
     _ingest_series(shard, n_series=2, n_samples=10, t0=1_000_000,
@@ -217,6 +228,35 @@ def test_ingest_watermark_tracks_max_timestamp():
     for c in b.containers():
         shard.ingest(c)
     assert shard.ingest_watermark_ms == 1_000_000 + 9 * 10_000
+    # a fresher series does NOT raise the bound: series 0 (still at
+    # 1_090_000) can legitimately ingest anywhere above its own last
+    _one(shard, _gauge_labels(1), 2_000_000)
+    assert shard.ingest_watermark_ms == 1_000_000 + 9 * 10_000
+    # ...and does: 1_500_000 lands fine despite being < the max
+    _one(shard, _gauge_labels(0), 1_500_000)
+    assert shard.stats.out_of_order_dropped == 1    # only the 500_000 row
+    # the laggard advanced: the min rises to the new laggard
+    assert shard.ingest_watermark_ms == 1_500_000
+    _one(shard, _gauge_labels(0), 3_000_000)
+    assert shard.ingest_watermark_ms == 2_000_000   # series 1 lags now
+
+
+def test_backfill_epoch_bumps_on_new_series_below_watermark():
+    """A NEW series is outside every per-partition OOO guard and can
+    land below the watermark, dirtying steps already considered
+    settled; the shard flags the event with a monotone epoch the
+    results cache invalidates on."""
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0)
+    _one(shard, _gauge_labels(0), 10_000)
+    assert shard.ingest_backfill_epoch == 0     # first contribution
+    # entering ABOVE the watermark touches no settled step: no bump
+    _one(shard, _gauge_labels(1), 50_000)
+    assert shard.ingest_backfill_epoch == 0
+    assert shard.ingest_watermark_ms == 10_000
+    # entering AT/BELOW the watermark is a backfill into settled time
+    _one(shard, _gauge_labels(2), 4_000)
+    assert shard.ingest_backfill_epoch == 1
+    assert shard.ingest_watermark_ms == 4_000   # entrant joins the min
 
 
 def test_decode_cache_bytes_and_trim(tmp_path):

@@ -71,8 +71,9 @@ class _StubEngine:
 
 
 class _Shard:
-    def __init__(self, wm):
+    def __init__(self, wm, epoch=0):
         self.ingest_watermark_ms = wm
+        self.ingest_backfill_epoch = epoch
 
 
 def _plan(start_s, step_s, end_s, q="up"):
@@ -171,6 +172,59 @@ def test_watermark_regression_invalidates():
     _run(rc, eng, 1000, 1600)
     assert len(rc) == 1
     sh.ingest_watermark_ms = 1200 * 1000    # stream replay / re-adoption
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss"
+    assert rc.snapshot()["watermark_invalidations"] == 1
+
+
+def test_backfill_epoch_invalidates():
+    """A new series entering a shard below its watermark (per-partition
+    OOO guards can't stop it) bumps the shard's backfill epoch; extents
+    recorded under the old epoch are dropped on lookup — the steps they
+    hold as settled may now miss samples."""
+    rc = ResultCache(max_bytes=1 << 20)
+    sh = _Shard(10_000_000 * 1000)
+    eng = _StubEngine(shards=[sh])
+    _run(rc, eng, 1000, 1600)
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "hit"
+    sh.ingest_backfill_epoch += 1
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss"
+    assert rc.snapshot()["backfill_invalidations"] == 1
+    # re-seeded under the new epoch: serves again
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "hit"
+
+
+def test_dispatch_scope_is_part_of_the_key():
+    """A dispatch=local / gRPC local_only evaluation (the pushdown
+    loop-prevention hop) sees only this node's shards; its extents and
+    a full fan-out query's extents must never serve each other."""
+    rc = ResultCache(max_bytes=1 << 20)
+    fan = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    local = _StubEngine(shards=[_Shard(10_000_000 * 1000)])
+    local.local_dispatch = True
+    _run(rc, fan, 1000, 1600)
+    _, ses = _run(rc, local, 1000, 1600)
+    assert ses.state == "miss"              # fan-out extent not reused
+    _, ses = _run(rc, local, 1000, 1600)
+    assert ses.state == "hit"               # local scope serves itself
+    _, ses = _run(rc, fan, 1000, 1600)
+    assert ses.state == "hit"               # fan-out extent untouched
+    assert len(rc) == 2
+
+
+def test_watermark_appearing_invalidates():
+    """An extent cached when NO shard had ingested (watermark None)
+    must not survive a shard starting to ingest: its backfill may land
+    below every cached step."""
+    rc = ResultCache(max_bytes=1 << 20)
+    eng = _StubEngine(shards=[])            # no local ingest yet
+    _run(rc, eng, 1000, 1600)
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "hit"               # hot window alone bounds it
+    eng.shards = [_Shard(1200 * 1000)]      # ingest starts at old time
     _, ses = _run(rc, eng, 1000, 1600)
     assert ses.state == "miss"
     assert rc.snapshot()["watermark_invalidations"] == 1
@@ -393,6 +447,7 @@ def test_metrics_exposition_has_cache_families(servers):
                 "filodb_result_cache_partial_hits_total",
                 "filodb_result_cache_bytes",
                 "filodb_result_cache_cached_steps_served_total",
+                "filodb_result_cache_backfill_invalidations_total",
                 "filodb_decode_cache_bytes",
                 "filodb_ingest_watermark_ms",
                 "filodb_resultcache_cached_steps_bucket"):
@@ -486,9 +541,11 @@ def test_server_series_churn_recomputes(fresh_srv):
         srv.store.ingest(srv.ref, 0, c)
     _, first = _get_json(srv, query=q, start=start, end=end, step=60)
     assert first["stats"]["timings"]["resultCache"] == "miss"
-    # second series appears, samples still inside the tail's lookback
+    # second series appears ABOVE the watermark (T0+590 — no backfill
+    # invalidation fires), samples inside the tail's lookback: the
+    # stitch must notice the unknown series and compute through
     b2 = RecordBuilder(DEFAULT_SCHEMAS)
-    for t in range(55, 65):
+    for t in range(60, 70):
         b2.add_sample("prom-counter", {"_metric_": "reqs_total",
                                        "instance": "i1"},
                       (T0 + t * 10) * 1000, float(t))
@@ -504,6 +561,49 @@ def test_server_series_churn_recomputes(fresh_srv):
                for r in after["data"]["result"]}
     assert len(metrics) == 2
     assert srv.http.result_cache.snapshot()["churn_recomputes"] >= 1
+
+
+def test_server_backfilled_series_invalidates(fresh_srv):
+    """A new series whose rows land entirely BELOW the watermark and
+    beyond the recomputed tail's lookback reach: churn stitching can
+    never see it, so the shard-side watermark/backfill signal must drop
+    the extent — the next query recomputes fresh and includes it."""
+    srv = fresh_srv
+    q = "rate(bf_total[1m])"
+    start, end = T0 + 300, T0 + 900
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(0, 60):
+        b.add_sample("prom-counter", {"_metric_": "bf_total",
+                                      "instance": "i0"},
+                     (T0 + t * 10) * 1000, float(t))
+    for c in b.containers():
+        srv.store.ingest(srv.ref, 0, c)
+    _, first = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert first["stats"]["timings"]["resultCache"] == "miss"
+    _, again = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert again["stats"]["timings"]["resultCache"] == "partial"
+    # i1 backfills T0+300..370 only: far below the watermark (T0+590)
+    # and invisible to the recomputed tail (1m windows reach ~T0+420
+    # after pow2 widening) — pre-invalidation this served stale cached
+    # steps missing the series
+    b2 = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(30, 38):
+        b2.add_sample("prom-counter", {"_metric_": "bf_total",
+                                       "instance": "i1"},
+                      (T0 + t * 10) * 1000, float(t))
+    for c in b2.containers():
+        srv.store.ingest(srv.ref, 0, c)
+    _, after = _get_json(srv, query=q, start=start, end=end, step=60)
+    assert after["stats"]["timings"]["resultCache"] == "miss"
+    _, golden = _get_json(srv, query=q, start=start, end=end, step=60,
+                          cache="false")
+    assert after["data"] == golden["data"]
+    metrics = {tuple(sorted(r["metric"].items()))
+               for r in after["data"]["result"]}
+    assert len(metrics) == 2
+    snap = srv.http.result_cache.snapshot()
+    assert (snap["watermark_invalidations"]
+            + snap["backfill_invalidations"]) >= 1
 
 
 def test_topology_change_invalidates(fresh_srv):
@@ -528,6 +628,100 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def _rows(body):
+    return {(tuple(sorted(r["metric"].items())), tuple(map(tuple,
+             r["values"]))) for r in body["data"]["result"]}
+
+
+def _ns_on_node(srv, metric, node):
+    """A namespace whose spread-0 shard key prunes onto ``node``."""
+    from filodb_tpu.core.record import shard_key_hash
+    for i in range(256):
+        ns = f"Ns-{i}"
+        skh = shard_key_hash(["demo", ns], metric)
+        shards = srv.mapper.query_shards(skh, 0)
+        if {srv.mapper.node_of(s) for s in shards} == {node}:
+            return ns
+    raise AssertionError("no namespace hashes onto the target node")
+
+
+def _seed_metric(srv, metric, ns):
+    """Seed a gauge on the node owning its shards (gateway routing)."""
+    from filodb_tpu.core.record import (RecordBuilder, RecordContainer,
+                                        ingestion_shard)
+    from filodb_tpu.core.schemas import PartitionSchema
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for inst in range(3):
+        labels = {"_metric_": metric, "_ws_": "demo", "_ns_": ns,
+                  "instance": f"i{inst}"}
+        for t in range(60):
+            b.add_sample("gauge", labels, (T0 + t * 10) * 1000,
+                         50.0 + inst + t * 0.1)
+    part_schema = PartitionSchema()
+    for cont in b.containers():
+        by_shard = {}
+        for row in cont.rows():
+            sh = ingestion_shard(row.part_key.shard_key_hash(part_schema),
+                                 row.part_key.part_hash(), 0, 4)
+            by_shard.setdefault(sh, RecordContainer(cont.schema))
+            by_shard[sh].add(row.part_key, row.timestamp, *row.values)
+        for sh, c2 in by_shard.items():
+            srv.store.get_shard(srv.ref, sh).ingest(c2)
+
+
+def test_pushdown_local_scope_never_serves_fanout():
+    """The pushdown hop (dispatch=local) evaluates only the target
+    node's shards; the extent it caches must live under a different key
+    than a direct fan-out query of the SAME text/step/phase on that
+    node — otherwise the user query would be served a local-only
+    (missing-series) result."""
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "default-spread": 0, "query-sample-limit": 0,
+        "query-series-limit": 0, "failure-detect-interval-s": 300.0,
+        "grpc-port": None, "query-timeout-s": 8.0,
+    }
+    a = FiloServer({**base, "node-ordinal": 0, "port": p0}).start()
+    b = FiloServer({**base, "node-ordinal": 1, "port": p1}).start()
+    try:
+        ns0 = _ns_on_node(a, "xg", "node0")
+        ns1 = _ns_on_node(a, "xg", "node1")
+        _seed_metric(a, "xg", ns0)
+        _seed_metric(b, "xg", ns1)
+        # shard-aligned self-join spanning both nodes: the planner
+        # pushes the WHOLE query to each owning node with
+        # dispatch=local (loop prevention) — the reviewed
+        # contamination path
+        sel = f'xg{{_ws_="demo",_ns_=~"{ns0}|{ns1}"}}'
+        q = f"({sel}) + ({sel})"
+        args = dict(query=q, start=T0 + 300, end=T0 + 580, step=60)
+        # a fans out; b evaluates its shards under dispatch=local and
+        # caches the local-only extent on the way
+        _, via_a = _get_json(a, **args)
+        assert len(via_a["data"]["result"]) == 6    # both nodes' series
+        assert b.http.result_cache.snapshot()["stores"] >= 1
+        # the same text/step/phase as a DIRECT fan-out query on b must
+        # NOT see that extent: it recomputes across both nodes and
+        # returns the full series set
+        _, via_b = _get_json(b, **args)
+        assert via_b["stats"]["timings"]["resultCache"] != "hit"
+        assert _rows(via_b) == _rows(via_a)
+        # and the local hop keeps serving its own scope: a repeat from
+        # a stitches/hits against b's local extent, unpolluted by b's
+        # fan-out extent
+        _, again = _get_json(a, **args)
+        assert _rows(again) == _rows(via_a)
+    finally:
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass
 
 
 def test_chaos_degraded_results_never_cached():
