@@ -27,8 +27,20 @@ from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
-from filodb_tpu.query.model import QueryError, RawSeries
+from filodb_tpu.query.model import (QueryError, RawSeries,
+                                    StaleRoutingError)
 from filodb_tpu.testing import chaos
+
+
+def _raise_peer_error(node_id: str, error: str) -> None:
+    """Map a peer's error string back to the right exception: a
+    stale-routing sentinel (the peer no longer serves the shards we
+    routed at it) round-trips losslessly through the wire's error
+    field; anything else is a plain peer QueryError."""
+    sr = StaleRoutingError.parse(error)
+    if sr is not None:
+        raise sr
+    raise QueryError(f"remote node {node_id}: {error}")
 
 _SERVICE = "filodb.QueryService"
 _channels: Dict[str, object] = {}
@@ -167,7 +179,7 @@ class GrpcShardGroup:
         series, error, spans = wire.decode_raw_response(buf)
         obs_trace.absorb_wire(spans)      # stitch the peer's subspans
         if error:
-            raise QueryError(f"remote node {self.node_id}: {error}")
+            _raise_peer_error(self.node_id, error)
         return series
 
     def lookup_partitions(self, filters, start_ts, end_ts):
@@ -188,10 +200,16 @@ class GrpcRemoteExec:
                  breakers: Optional[BreakerRegistry] = None,
                  deadline: Optional[Deadline] = None,
                  http_fallback: Optional[str] = None,
-                 no_cache: bool = False):
+                 no_cache: bool = False,
+                 expect_shards: Optional[Sequence[int]] = None):
         # structural plan tree (query.planwire); when present the peer
         # executes it directly and `query` is only a debug label
         self.plan_wire = plan_wire
+        # stale-routing guard: the shards the entry node believes this
+        # peer owns; the peer bounces instead of silently evaluating a
+        # subset when a handoff moved one away (ExecRequest field 12)
+        self.expect_shards = list(expect_shards) \
+            if expect_shards is not None else None
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -218,7 +236,7 @@ class GrpcRemoteExec:
             timeout_s=self.timeout_s, stats=self.stats,
             local_only=self.local_only, retry=self.retry,
             breakers=self.breakers, deadline=self.deadline,
-            no_cache=self.no_cache)
+            no_cache=self.no_cache, expect_shards=self.expect_shards)
 
     def _deadline_ms(self) -> int:
         if self.deadline is None:
@@ -241,7 +259,9 @@ class GrpcRemoteExec:
                 plan_wire=self.plan_wire,
                 deadline_ms=self._deadline_ms(),
                 trace_ctx=obs_trace.inject_header() or "",
-                no_cache=self.no_cache)
+                no_cache=self.no_cache,
+                expect_shards=(self.expect_shards
+                               if self.local_only else None))
             return _call(self.addr, "Exec", payload, timeout_s,
                          self.node_id)
 
@@ -263,7 +283,7 @@ class GrpcRemoteExec:
             wire.decode_exec_response(buf)
         obs_trace.absorb_wire(stats.get("trace_spans"))
         if error:
-            raise QueryError(f"remote node {self.node_id}: {error}")
+            _raise_peer_error(self.node_id, error)
         partial = bool(stats.get("partial"))
         warnings = list(stats.get("warnings") or ())
         if self.stats is not None:

@@ -140,6 +140,30 @@ class GrpcQueryServer:
         try:
             req = wire.decode_exec_request(request)
             tr = self._req_trace(req)
+            if req["local_only"] and req.get("expect_shards"):
+                # stale-routing guard (ExecRequest field 12): bounce
+                # instead of silently evaluating over a subset when a
+                # planned handoff moved one of the expected shards away
+                have = {getattr(s, "shard_num", i) for i, s in
+                        enumerate(self.http.shards_by_dataset.get(
+                            req["dataset"], ()))}
+                missing = [n for n in req["expect_shards"]
+                           if n not in have]
+                if missing:
+                    from filodb_tpu.query.model import StaleRoutingError
+                    mapper = self.http.shard_mapper
+                    self.http.stale_routing_bounces += 1
+                    err = StaleRoutingError(
+                        owners={n: mapper.node_of(n) for n in missing}
+                        if mapper is not None else {},
+                        epoch=getattr(mapper, "topology_epoch", 0)
+                        if mapper is not None else 0,
+                        node=getattr(self.http, "node_id", "") or "",
+                        detail=f"shards {sorted(missing)} are not "
+                               f"served here")
+                    return wire.encode_exec_response(
+                        None, error=str(err),
+                        trace_spans=obs_trace.spans_wire(tr))
             engine = self.http.make_planner(
                 req["dataset"], local_dispatch=req["local_only"],
                 deadline=self._req_deadline(

@@ -27,7 +27,10 @@ Messages (field numbers):
   ExecRequest   {1: dataset, 2: query, 3: start_ms, 4: step_ms,
                  5: end_ms, 6: local_only, 7: hist_wire,
                  9: deadline_ms (caller's remaining budget; 0 = none),
-                 10: trace ctx "trace_id-parent_span-1"}
+                 10: trace ctx "trace_id-parent_span-1",
+                 11: no_cache (results-cache bypass propagation),
+                 12: expect_shards packed (stale-routing guard on
+                 local_only pushdown hops)}
   ExecSeries    {1: label entry*, 2: values nibble (grid-aligned,
                  NaN where absent), 3: hist nibble flat, 4: nb}
   ExecResponse  {1: ExecSeries*, 2: error, 3: steps nibble,
@@ -289,14 +292,18 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
                         plan_wire: bytes = b"",
                         deadline_ms: int = 0,
                         trace_ctx: str = "",
-                        no_cache: bool = False) -> bytes:
+                        no_cache: bool = False,
+                        expect_shards=None) -> bytes:
     """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
     the reference's exec_plan.proto capability; the printed query text
     stays alongside for debuggability and older peers. Field 9 carries
     the caller's remaining deadline budget in ms (server-side deadline
     propagation; 0/absent = none). Field 10 carries the propagated
     trace context (absent = untraced). Field 11 propagates the caller's
-    results-cache bypass (&cache=false) so the peer skips its cache."""
+    results-cache bypass (&cache=false) so the peer skips its cache.
+    Field 12 (packed uvarints) names the shards the caller expects the
+    peer to serve on a local_only hop — the peer bounces stale_routing
+    instead of silently evaluating over a subset after a handoff."""
     out = (_ld(1, dataset.encode()) + _ld(2, query.encode())
            + _vi(3, int(start_ms)) + _vi(4, int(step_ms))
            + _vi(5, int(end_ms)) + _vi(6, 1 if local_only else 0))
@@ -308,13 +315,17 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
         out += _ld(10, trace_ctx.encode())
     if no_cache:
         out += _vi(11, 1)
+    if expect_shards:
+        out += _ld(12, b"".join(_uvarint(int(s))
+                                for s in expect_shards))
     return out
 
 
 def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
            "end_ms": 0, "local_only": True, "plan_wire": b"",
-           "deadline_ms": 0, "trace": "", "no_cache": False}
+           "deadline_ms": 0, "trace": "", "no_cache": False,
+           "expect_shards": None}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -336,6 +347,12 @@ def decode_exec_request(buf: bytes) -> Dict:
             req["trace"] = v.decode()
         elif f == 11:
             req["no_cache"] = bool(v)
+        elif f == 12:
+            shards, pos = [], 0
+            while pos < len(v):
+                s, pos = _read_uvarint(v, pos)
+                shards.append(s)
+            req["expect_shards"] = shards
     return req
 
 

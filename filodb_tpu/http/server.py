@@ -36,7 +36,8 @@ from filodb_tpu.query import logical as lp
 from filodb_tpu.query.engine import QueryEngine  # noqa: F401 (re-export)
 from filodb_tpu.query.planner import QueryPlanner
 from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
-                                    QueryLimits, ScalarResult)
+                                    QueryLimits, ScalarResult,
+                                    StaleRoutingError)
 
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
 
@@ -118,6 +119,22 @@ class FiloHttpServer:
         # set by the standalone server: FailureDetector whose down-view
         # rides the health body (quorum input for elastic reassignment)
         self.detector = None
+        # set by the standalone server: MembershipManager behind the
+        # /admin/{drain,adopt,transfer,abort_adopt} endpoints
+        self.membership = None
+        # elastic membership read-path state:
+        #  * handoff_sources — shard -> previous-owner node for shards
+        #    THIS node is adopting mid-handoff; the planner redirects
+        #    reads there until the replay flips ACTIVE, so no query
+        #    ever sees a half-replayed copy;
+        #  * peer_watermarks — gossiped per-peer ingest watermarks /
+        #    backfill epochs (FailureDetector peer_state_sink) stamped
+        #    onto remote shard groups for results-cache freshness;
+        #  * stale-routing counters for /metrics.
+        self.handoff_sources: Dict[int, str] = {}
+        self.peer_watermarks: Dict[str, Dict] = {}
+        self.stale_routing_bounces = 0
+        self.stale_routing_retries = 0
         # observability spine (filodb_tpu.obs): the tracer owns the
         # sampling decision + the bounded ring behind /debug/traces;
         # the slow-query log and in-flight registry serve
@@ -326,17 +343,35 @@ class FiloHttpServer:
             # node's own down-view of its peers (quorum input for
             # elastic reassignment). FilodbCluster.scala gossip analogue.
             shards_adv: Dict[str, str] = {}
-            if self.shard_mapper is not None:
-                served = {getattr(s, "shard_num", i)
-                          for lst in self.shards_by_dataset.values()
-                          for i, s in enumerate(lst)}
-                for n in served:
-                    shards_adv[str(n)] = \
-                        self.shard_mapper.status(n).value
+            watermarks: Dict[str, int] = {}
+            epochs: Dict[str, int] = {}
+            for lst in self.shards_by_dataset.values():
+                for i, s in enumerate(lst):
+                    n = getattr(s, "shard_num", i)
+                    if self.shard_mapper is not None:
+                        shards_adv[str(n)] = \
+                            self.shard_mapper.status(n).value
+                    # per-shard ingest watermark + backfill epoch ride
+                    # the health body (ROADMAP 4a): peers stamp them
+                    # onto remote shard groups so the results cache's
+                    # freshness horizon covers fan-out extents too
+                    wm = getattr(s, "ingest_watermark_ms", None)
+                    if wm is not None:
+                        watermarks[str(n)] = int(wm)
+                    epochs[str(n)] = int(getattr(
+                        s, "ingest_backfill_epoch", 0) or 0)
             down = (sorted(self.detector.down_peers())
                     if self.detector is not None else [])
             body = {"status": "healthy", "shards": shards_adv,
-                    "down_peers": down}
+                    "down_peers": down,
+                    "watermarks": watermarks,
+                    "backfill_epochs": epochs}
+            if self.shard_mapper is not None \
+                    and hasattr(self.shard_mapper, "topology_epoch"):
+                body["topo_epoch"] = self.shard_mapper.topology_epoch
+            mem = self.membership
+            if mem is not None:
+                body["draining"] = bool(mem.draining)
             gs = getattr(self, "grpc_server", None)
             if gs is not None:
                 # advertise the data-plane port; peers combine it with
@@ -348,6 +383,8 @@ class FiloHttpServer:
             return 200, body
         if path == "/metrics":
             return 200, self._metrics_text()
+        if path.startswith("/admin/"):
+            return self._admin(path, qs, body_json)
         if path == "/debug/traces":
             return 200, self._debug_traces(qs)
         if path == "/debug/queries":
@@ -391,22 +428,59 @@ class FiloHttpServer:
         # reads nor seeds the cache, and pushdown hops propagate the flag
         no_cache = (self._param(qs, "cache", "")
                     or "").lower() in ("false", "0", "no")
+        # stale-routing bounce (pushdown plane): a dispatch=local hop
+        # names the shards the entry node expects this peer to serve;
+        # if a handoff moved one away, bounce with the new owners
+        # instead of silently evaluating over a subset
+        if local_dispatch and rest in ("query_range", "query"):
+            raw_expect = self._param(qs, "expect_shards")
+            if raw_expect:
+                try:
+                    want = [int(x) for x in raw_expect.split(",") if x]
+                except ValueError:
+                    raise QueryError(
+                        f"bad expect_shards {raw_expect!r}")
+                missing = [n for n in want
+                           if n not in self._local_shard_nums(ds)]
+                if missing:
+                    return 200, self._stale_routing_payload(missing)
+
+        def mk_engine():
+            eng = self.make_planner(ds, local_dispatch=local_dispatch,
+                                    deadline=deadline,
+                                    allow_partial=allow_partial,
+                                    no_result_cache=no_cache)
+            if eng is None:
+                raise QueryError(f"dataset {ds} not set up")
+            return eng
+        if rest == "query_range":
+            fn = lambda eng: self._query_range(eng, qs, ds, tctx=tctx)
+        elif rest == "query":
+            fn = lambda eng: self._query_instant(eng, qs, ds, tctx=tctx)
+        else:
+            fn = None
+        if fn is not None:
+            if self._query_gate is None:
+                code, payload = self._run_query_routing_retry(
+                    mk_engine, fn)
+            else:
+                with self._query_gate:
+                    code, payload = self._run_query_routing_retry(
+                        mk_engine, fn)
+            if local_dispatch and isinstance(payload, dict) \
+                    and self.shard_mapper is not None \
+                    and hasattr(self.shard_mapper, "topology_epoch"):
+                # a pushdown hop's response carries the responder's
+                # topology epoch alongside the result (client-facing
+                # responses are untouched — this is the peer plane)
+                payload["topo_epoch"] = self.shard_mapper.topology_epoch
+            return code, payload
         engine = self.make_planner(ds, local_dispatch=local_dispatch,
                                    deadline=deadline,
                                    allow_partial=allow_partial,
                                    no_result_cache=no_cache)
         if engine is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
-        if rest == "query_range":
-            if self._query_gate is None:
-                return self._query_range(engine, qs, ds, tctx=tctx)
-            with self._query_gate:
-                return self._query_range(engine, qs, ds, tctx=tctx)
-        if rest == "query":
-            if self._query_gate is None:
-                return self._query_instant(engine, qs, ds, tctx=tctx)
-            with self._query_gate:
-                return self._query_instant(engine, qs, ds, tctx=tctx)
         if rest == "labels":
             return self._labels(engine, qs, ds)
         lm = re.match(r"^label/(?P<name>[^/]+)/values$", rest)
@@ -417,6 +491,118 @@ class FiloHttpServer:
         if rest == "read":
             return self._remote_read(ds, body_raw)
         return 404, prom_json.error(f"no route for {path}", "not_found")
+
+    # -- elastic membership admin plane -----------------------------------
+    def _admin(self, path: str, qs: Dict, body: Optional[Dict]):
+        """POST /admin/drain | /admin/adopt | /admin/transfer |
+        /admin/abort_adopt — the planned-membership control plane
+        (parallel/membership.py). Peer-facing endpoints answer HTTP 200
+        with a status envelope like the query plane, so callers share
+        one error-handling path."""
+        mem = self.membership
+        if mem is None:
+            return 400, prom_json.error(
+                "elastic membership is not enabled on this node")
+        body = body or {}
+        if path == "/admin/drain":
+            timeout = self._param(qs, "timeout")
+            out = mem.drain(timeout_s=float(timeout)
+                            if timeout else None)
+            return 200, {"status": "success", "data": out}
+        if path == "/admin/adopt":
+            if body.get("shard") is None:
+                return 400, prom_json.error("adopt: missing shard")
+            out = mem.accept_adopt(int(body["shard"]),
+                                   str(body.get("from") or ""))
+            return 200, {"status": "success", "data": out}
+        if path == "/admin/transfer":
+            if body.get("shard") is None or not body.get("owner"):
+                return 400, prom_json.error(
+                    "transfer: missing shard/owner")
+            out = mem.apply_transfer(int(body["shard"]),
+                                     str(body["owner"]))
+            return 200, {"status": "success", "data": out}
+        if path == "/admin/abort_adopt":
+            if body.get("shard") is None:
+                return 400, prom_json.error("abort_adopt: missing shard")
+            out = mem.abort_adopt(int(body["shard"]),
+                                  str(body.get("owner") or ""))
+            return 200, {"status": "success", "data": out}
+        return 404, prom_json.error(f"no route for {path}", "not_found")
+
+    def _local_shard_nums(self, ds: str) -> set:
+        return {getattr(s, "shard_num", i)
+                for i, s in enumerate(self.shards_by_dataset.get(ds, ()))}
+
+    def _stale_routing_payload(self, missing) -> Dict:
+        """The bounce envelope a peer returns instead of silently
+        evaluating over a subset of the shards the caller routed at it:
+        names the owners THIS node's mapper records (it witnessed the
+        handoff), so the caller can rewire and retry."""
+        owners = {}
+        if self.shard_mapper is not None:
+            owners = {str(n): self.shard_mapper.node_of(n)
+                      for n in missing}
+        epoch = getattr(self.shard_mapper, "topology_epoch", 0) \
+            if self.shard_mapper is not None else 0
+        self.stale_routing_bounces += 1
+        err = StaleRoutingError(
+            owners={int(k): v for k, v in owners.items()},
+            epoch=epoch, node=self.node_id or "",
+            detail="shards %s are not served here" % sorted(missing))
+        return {"status": "error", "errorType": "stale_routing",
+                "error": str(err), "owners": owners,
+                "topo_epoch": epoch}
+
+    def _apply_owner_hints(self, e: StaleRoutingError) -> None:
+        """Fold a stale-routing responder's owner map into the local
+        mapper before re-materializing: the responder is the former
+        owner and witnessed the handoff. Hints naming unknown nodes —
+        or claiming THIS node serves a shard it doesn't — are ignored
+        (the retry then waits for gossip/transfer to converge)."""
+        if self.shard_mapper is None:
+            return
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        local = {n for lst in self.shards_by_dataset.values()
+                 for n in (getattr(s, "shard_num", i)
+                           for i, s in enumerate(lst))}
+        for sh, owner in sorted(e.owners.items()):
+            if not owner or not (0 <= sh < self.shard_mapper.num_shards):
+                continue
+            if owner == self.node_id:
+                if sh not in local:
+                    continue        # bogus hint: we don't serve it
+            elif owner not in self.peers:
+                continue
+            if self.shard_mapper.node_of(sh) != owner:
+                self.shard_mapper.assign(sh, owner)
+                self.shard_mapper.update(sh, ShardStatus.ACTIVE, owner)
+
+    def _run_query_routing_retry(self, mk_engine, fn):
+        """Execute a query, re-resolving routing on StaleRoutingError:
+        a peer mid-/post-handoff bounced rather than answer for shards
+        it no longer serves. The bounce carries the new owners; apply
+        them, drop cached plans/results keyed on the stale world, and
+        re-materialize. A stale-epoch peer response is therefore never
+        returned to a client — the query either converges on fresh
+        routing or fails loudly after bounded attempts."""
+        import time as _time
+        attempts = 3
+        for i in range(attempts):
+            try:
+                return fn(mk_engine())
+            except StaleRoutingError as e:
+                self.stale_routing_retries += 1
+                self._apply_owner_hints(e)
+                # plans are routing-independent but the results cache
+                # keys on the topology world: drop both (the listener
+                # wiring clears the results cache too)
+                self.plan_cache.invalidate("stale-routing")
+                if i == attempts - 1:
+                    raise QueryError(
+                        "shard routing did not converge after "
+                        f"{attempts} attempts: {e.detail or e}")
+                _time.sleep(0.05 * (i + 1))
 
     def make_planner(self, ds: str, local_dispatch: bool = False,
                      deadline: Optional[Deadline] = None,
@@ -432,7 +618,21 @@ class FiloHttpServer:
         partitions = {} if local_dispatch else self.partitions
         grpc_peers = {} if local_dispatch else self.grpc_peers
         grpc_partitions = {} if local_dispatch else self.grpc_partitions
+        # mid-handoff read redirect: shards this node is adopting route
+        # back to their still-serving previous owner until replay
+        # completes (resolved to URLs here; applies under dispatch=local
+        # too — the data is by definition this node's shard set)
+        handoff = {}
+        if self.handoff_sources:
+            down = set(self.detector.down_peers()) \
+                if self.detector is not None else set()
+            for sh, node in dict(self.handoff_sources).items():
+                url = self.peers.get(node)
+                if url and node not in down:
+                    handoff[sh] = (node, url)
         return QueryPlanner(shards, backend=self.backend,
+                            handoff_sources=handoff,
+                            peer_watermarks=self.peer_watermarks,
                             deadline=deadline,
                             allow_partial=allow_partial,
                             no_result_cache=no_result_cache,
@@ -894,6 +1094,39 @@ class FiloHttpServer:
             "Seconds since the last tenant-metering snapshot",
         "filodb_tenant_metering_snapshots_total":
             "Tenant-metering snapshots taken",
+        "filodb_topology_epoch":
+            "Monotone topology epoch (bumped on every shard-ownership "
+            "change; plan/results caches invalidate on it)",
+        "filodb_shard_handoff_started_total":
+            "Planned shard handoffs started (drain + hand-back)",
+        "filodb_shard_handoff_completed_total":
+            "Planned shard handoffs completed (ownership flipped, "
+            "local copy released)",
+        "filodb_shard_handoff_failed_total":
+            "Planned shard handoffs rolled back to the draining owner",
+        "filodb_shard_adoptions_total":
+            "Shards adopted by this node (kind=planned handoff / "
+            "kind=crash reassignment)",
+        "filodb_shard_releases_total":
+            "Local shard copies released (handoff completion or "
+            "owner return)",
+        "filodb_membership_draining":
+            "1 while this node is draining its shards for a planned "
+            "restart",
+        "filodb_membership_incoming_shards":
+            "Planned adoptions currently replaying on this node",
+        "filodb_handback_failures_total":
+            "Hand-back handoffs that exhausted their retries (shard "
+            "stays on the temporary owner)",
+        "filodb_stale_routing_bounces_total":
+            "Peer requests bounced because they named shards this "
+            "node no longer serves",
+        "filodb_stale_routing_retries_total":
+            "Queries re-materialized against fresh routing after a "
+            "peer's stale-routing bounce",
+        "filodb_detector_thread_wedged":
+            "1 if the failure-detector monitor thread failed to exit "
+            "on stop()",
         "filodb_traces_started_total": "Traces started on this node",
         "filodb_traces_stored": "Finished traces in /debug/traces",
         "filodb_slow_queries_total": "Queries over the slow-query "
@@ -1013,6 +1246,38 @@ class FiloHttpServer:
              rc["cached_steps_served"])
         emit("result_cache_computed_steps_served_total", {},
              rc["computed_steps_served"])
+        # elastic membership: topology epoch, handoff/adoption state,
+        # stale-routing bounce/retry counters, detector liveness
+        if self.shard_mapper is not None \
+                and hasattr(self.shard_mapper, "topology_epoch"):
+            emit("topology_epoch", {},
+                 self.shard_mapper.topology_epoch)
+        mem = self.membership
+        if mem is not None:
+            ms = mem.metrics_snapshot()
+            emit("shard_handoff_started_total", {},
+                 ms["handoffs_started"])
+            emit("shard_handoff_completed_total", {},
+                 ms["handoffs_completed"])
+            emit("shard_handoff_failed_total", {},
+                 ms["handoffs_failed"])
+            emit("shard_adoptions_total", {"kind": "planned"},
+                 ms["adoptions_planned"])
+            emit("shard_adoptions_total", {"kind": "crash"},
+                 ms["adoptions_crash"])
+            emit("shard_releases_total", {}, ms["releases"])
+            emit("membership_draining", {}, ms["draining"])
+            emit("membership_incoming_shards", {}, ms["incoming"])
+            emit("handback_failures_total", {},
+                 ms["handback_failures"])
+        emit("stale_routing_bounces_total", {},
+             self.stale_routing_bounces)
+        emit("stale_routing_retries_total", {},
+             self.stale_routing_retries)
+        if self.detector is not None:
+            emit("detector_thread_wedged", {},
+                 1 if getattr(self.detector, "thread_wedged", False)
+                 else 0)
         gs = getattr(self, "grpc_server", None)
         if gs is not None:
             emit("grpc_rpcs_served_total", {}, gs.rpcs_served)
@@ -1127,15 +1392,31 @@ class FiloHttpServer:
             with obs_trace.span("peer-fetch-raw",
                                 node=self.node_id or "", dataset=ds,
                                 plane="http"):
-                series = self.leaf_select(
-                    ds, wire_to_filters(body.get("filters", [])),
-                    int(body["start_ms"]), int(body["end_ms"]),
-                    body.get("column"), body.get("shards"),
-                    span_snap=bool(body.get("full", True)),
-                    stats=QueryStats(), deadline=deadline)
+                try:
+                    series = self.leaf_select(
+                        ds, wire_to_filters(body.get("filters", [])),
+                        int(body["start_ms"]), int(body["end_ms"]),
+                        body.get("column"), body.get("shards"),
+                        span_snap=bool(body.get("full", True)),
+                        stats=QueryStats(), deadline=deadline)
+                except StaleRoutingError as e:
+                    # HTTP 200 + error envelope (not a 4xx): the
+                    # caller must read the owners hint, and a non-2xx
+                    # would surface as a retryable transport error
+                    return 200, {
+                        "status": "error",
+                        "errorType": "stale_routing", "error": str(e),
+                        "owners": {str(k): v
+                                   for k, v in e.owners.items()},
+                        "topo_epoch": e.epoch}
         if series is None:
             return 400, prom_json.error(f"dataset {ds} not set up")
         out = {"status": "success", "data": series_to_wire(series)}
+        # every peer response carries the responder's topology epoch:
+        # the entry node can cross-check its routing freshness
+        if self.shard_mapper is not None \
+                and hasattr(self.shard_mapper, "topology_epoch"):
+            out["topo_epoch"] = self.shard_mapper.topology_epoch
         if tr is not None:
             out["trace_spans"] = tr.spans_json()
         return 200, out
@@ -1148,7 +1429,11 @@ class FiloHttpServer:
         keys, so the payload scales with the query span, not retention
         (SerializedRangeVector semantics, RangeVector.scala:452).
         ``deadline`` carries the entry node's forwarded remaining
-        budget; selection checks it per shard and fails fast."""
+        budget; selection checks it per shard and fails fast. A wanted
+        shard that is NOT served here raises StaleRoutingError (with
+        this node's owner map) instead of silently answering for a
+        subset — the caller's routing lags a handoff and must not hand
+        an incomplete result to its client."""
         from filodb_tpu.query.engine import (select_raw_series,
                                              select_span_series)
         shards = self.shards_by_dataset.get(ds)
@@ -1156,6 +1441,22 @@ class FiloHttpServer:
             return None
         by_num = {getattr(s, "shard_num", i): s
                   for i, s in enumerate(shards)}
+        if want_shards is not None:
+            missing = [int(n) for n in want_shards if n not in by_num]
+            if missing:
+                self.stale_routing_bounces += 1
+                owners = {}
+                if self.shard_mapper is not None:
+                    owners = {n: self.shard_mapper.node_of(n)
+                              for n in missing}
+                raise StaleRoutingError(
+                    owners=owners,
+                    epoch=getattr(self.shard_mapper, "topology_epoch",
+                                  0) if self.shard_mapper is not None
+                    else 0,
+                    node=self.node_id or "",
+                    detail=f"shards {sorted(missing)} are not served "
+                           f"here")
         subset = [by_num[n] for n in want_shards if n in by_num] \
             if want_shards is not None else shards
         if span_snap:

@@ -10,9 +10,12 @@ LEAF data selection out to the peers owning the other shards over plain
 HTTP (the host control plane — bulk device compute stays node-local), and
 the full plan evaluates on the entry node over the merged series. Node
 loss is detected by health polling (Akka gossip/DeathWatch equivalent,
-FilodbCluster.scala) and flips the lost node's shards DOWN in the local
-ShardMapper — queries then exclude them (ShardManager.scala:28 semantics
-without reassignment; shards come back when the peer does).
+FilodbCluster.scala): the lost node's shards flip DOWN, and past the
+quorum-gated grace window survivors ADOPT them (ShardManager.scala:28
+assignShardsToNodes). Planned topology changes — rolling-restart drain
+and rejoin hand-back — run the make-before-break handoff protocol in
+parallel/membership.py instead of the crash machinery, with topology
+epochs and stale-routing retries keeping peer routing coherent.
 """
 
 from __future__ import annotations
@@ -33,7 +36,8 @@ from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
-from filodb_tpu.query.model import QueryError, RawSeries
+from filodb_tpu.query.model import (QueryError, RawSeries,
+                                    StaleRoutingError)
 from filodb_tpu.testing import chaos
 
 
@@ -106,6 +110,17 @@ def _get_json(url_or_req, node_id: str, timeout_s: float) -> Dict:
     except (OSError, ValueError) as e:      # ValueError: garbled body
         raise TransportError(f"remote node {node_id} unreachable: {e}")
     if payload.get("status") != "success":
+        if payload.get("errorType") == "stale_routing":
+            # the peer no longer serves the shards we routed at it (a
+            # planned handoff moved them): NOT retryable against the
+            # same peer — the entry node re-resolves routing instead
+            raise StaleRoutingError(
+                owners=payload.get("owners"),
+                epoch=int(payload.get("topo_epoch") or 0),
+                node=node_id, detail=str(payload.get("error") or ""))
+        sr = StaleRoutingError.parse(payload.get("error"))
+        if sr is not None:
+            raise sr
         raise QueryError(f"remote node {node_id}: {payload.get('error')}")
     return payload
 
@@ -228,7 +243,8 @@ class PromQlRemoteExec:
                  retry: Optional[RetryPolicy] = None,
                  breakers: Optional[BreakerRegistry] = None,
                  deadline: Optional[Deadline] = None,
-                 no_cache: bool = False):
+                 no_cache: bool = False,
+                 expect_shards: Optional[Sequence[int]] = None):
         self.query = query
         self.start_ms = start_ms
         self.step_ms = step_ms
@@ -238,6 +254,11 @@ class PromQlRemoteExec:
         self.dataset = dataset
         self.timeout_s = timeout_s
         self.stats = stats      # planner QueryStats: peer stats fold in
+        # the shard set the entry node believes this peer owns: the
+        # peer bounces the query (stale_routing) instead of silently
+        # evaluating over a subset when a handoff moved one away
+        self.expect_shards = list(expect_shards) \
+            if expect_shards is not None else None
         # pushdown within a cluster pins the peer to its local shards;
         # cross-cluster federation lets the remote cluster plan freely
         # (MultiPartitionPlanner semantics)
@@ -266,6 +287,9 @@ class PromQlRemoteExec:
             path = "query_range"
         if self.local_only:
             qs["dispatch"] = "local"    # no fan-back-out (loop prevention)
+            if self.expect_shards:
+                qs["expect_shards"] = ",".join(
+                    str(int(s)) for s in self.expect_shards)
         if self.no_cache:
             qs["cache"] = "false"
         qs["hist-wire"] = "1"
@@ -367,7 +391,8 @@ class FailureDetector:
                  timeout_s: float = 2.0,
                  reassign_grace_s: Optional[float] = None,
                  on_node_down=None, on_node_up=None,
-                 grpc_peer_sink: Optional[Dict[str, str]] = None):
+                 grpc_peer_sink: Optional[Dict[str, str]] = None,
+                 peer_state_sink: Optional[Dict[str, Dict]] = None):
         self.mapper = mapper
         self.peers = dict(peers)
         # mutable {node -> "host:port"} the poller fills from peers'
@@ -375,6 +400,18 @@ class FailureDetector:
         # so leaf dispatch upgrades to the binary data plane as soon as
         # a peer is discovered)
         self.grpc_peer_sink = grpc_peer_sink
+        # mutable {node -> {"watermarks": {shard: ms}, "epochs":
+        # {shard: n}, "topo_epoch": n}} filled from peers' health
+        # bodies (ROADMAP 4a): the planner stamps remote shard groups
+        # with gossiped ingest watermarks + backfill epochs so the
+        # results cache's freshness horizon covers fan-out extents too.
+        # Entries are dropped the moment a peer goes down — a stale
+        # advertisement must not bound freshness.
+        self.peer_state_sink = peer_state_sink
+        # set by stop() when the monitor thread failed to exit within
+        # the join timeout; surfaced as the detector_thread_wedged
+        # gauge so chaos runs can't silently leak pollers
+        self.thread_wedged = False
         self.shards_by_node = {k: list(v) for k, v in
                                shards_by_node.items()}
         self.interval_s = interval_s
@@ -452,20 +489,33 @@ class FailureDetector:
             if self.mapper.status(sh) is not st:
                 self.mapper.update(sh, st, node)
 
+    @staticmethod
+    def _int_map(raw) -> Dict[int, object]:
+        try:
+            return {int(k): v for k, v in (raw or {}).items()}
+        except (TypeError, ValueError):
+            return {}
+
     def poll_once(self) -> None:
         for node, url in self.peers.items():
             body = self._probe(url)
             if body is not None:
                 self._misses[node] = 0
-                adv = {}
-                try:
-                    adv = {int(k): v for k, v in
-                           (body.get("shards") or {}).items()}
-                except (TypeError, ValueError):
-                    pass
+                adv = self._int_map(body.get("shards"))
                 self._peer_shards[node] = adv
                 self._peer_down_view[node] = set(
                     body.get("down_peers") or ())
+                if self.peer_state_sink is not None:
+                    # watermark/epoch gossip (ROADMAP 4a): the planner
+                    # reads this to stamp remote shard groups for the
+                    # results cache's freshness horizon
+                    self.peer_state_sink[node] = {
+                        "watermarks": self._int_map(
+                            body.get("watermarks")),
+                        "epochs": self._int_map(
+                            body.get("backfill_epochs")),
+                        "topo_epoch": int(body.get("topo_epoch") or 0),
+                    }
                 gport = body.get("grpc_port")
                 if gport and self.grpc_peer_sink is not None:
                     host = urllib.parse.urlparse(url).hostname \
@@ -480,42 +530,59 @@ class FailureDetector:
                         self.grpc_peer_sink[node] = addr
                         if old is not None:
                             _drop_grpc_channel(old)
-                if self._down[node]:
+                came_back = self._down[node]
+                if came_back:
                     self._down[node] = False
                     self._down_since.pop(node, None)
-                    if self._reassigned.get(node, False):
-                        self._reassigned[node] = False
-                        if self.on_node_up is not None:
-                            try:
-                                self.on_node_up(node)
-                            except Exception:
-                                # a failing hook must not kill the
-                                # monitoring thread
-                                pass
-                            continue
-                        # no release hook: fall through and hand the
-                        # shards back so they don't stay reassigned
-                        # forever
-                    for sh in self.shards_by_node.get(node, []):
-                        # honor what the returning node ADVERTISES: a
-                        # node mid-replay says "recovery" and must not
-                        # be flipped ACTIVE (queries would lose the
-                        # partial-result warning until the next poll)
+                if self._reassigned.get(node, False):
+                    # the node is healthy but its shards are still
+                    # reassigned away. Run the release hook; only a
+                    # SUCCESSFUL hook clears the flag, so a raising
+                    # hook is retried on the next poll instead of
+                    # wedging ownership on the adopters forever
+                    if self.on_node_up is not None:
                         try:
-                            st = ShardStatus(adv[sh]) if sh in adv \
-                                else ShardStatus.ACTIVE
-                        except ValueError:
-                            st = ShardStatus.ACTIVE
-                        self.mapper.update(sh, st, node)
+                            self.on_node_up(node)
+                            self._reassigned[node] = False
+                            continue
+                        except Exception:
+                            # fall through to the mapper-level hand-
+                            # back below (ownership must not wedge);
+                            # the hook retries next poll
+                            pass
+                    else:
+                        self._reassigned[node] = False
+                    hand_back = list(self.shards_by_node.get(node, []))
+                elif came_back:
+                    # plain bounce (no reassignment fired): restore
+                    # only what the mapper STILL assigns to the node —
+                    # a planned handoff may have rewired ownership
+                    # while it was away, and a drained node owns none
+                    hand_back = list(self.mapper.shards_for_node(node))
                 else:
                     self._sync_peer_statuses(node, adv)
+                    continue
+                for sh in hand_back:
+                    # honor what the returning node ADVERTISES: a
+                    # node mid-replay says "recovery" and must not
+                    # be flipped ACTIVE (queries would lose the
+                    # partial-result warning until the next poll)
+                    try:
+                        st = ShardStatus(adv[sh]) if sh in adv \
+                            else ShardStatus.ACTIVE
+                    except ValueError:
+                        st = ShardStatus.ACTIVE
+                    self.mapper.update(sh, st, node)
             else:
                 self._misses[node] += 1
                 if self._misses[node] >= self.threshold \
                         and not self._down[node]:
                     self._down[node] = True
                     self._down_since[node] = time.monotonic()
-                    for sh in self.shards_by_node.get(node, []):
+                    # flip the shards the mapper assigns the node NOW
+                    # (not the startup assignment): planned handoffs
+                    # rewire ownership, and a drained node owns nothing
+                    for sh in self.mapper.shards_for_node(node):
                         self.mapper.update(sh, ShardStatus.DOWN, node)
                     # forget the dead node's data-plane address: when it
                     # returns (likely on a new ephemeral port) the sink
@@ -525,6 +592,10 @@ class FailureDetector:
                         old = self.grpc_peer_sink.pop(node, None)
                         if old is not None:
                             _drop_grpc_channel(old)
+                    # a dead peer's gossiped watermarks must not keep
+                    # bounding the results cache's freshness horizon
+                    if self.peer_state_sink is not None:
+                        self.peer_state_sink.pop(node, None)
                 if (self._down[node] and self.reassign_grace_s is not None
                         and not self._reassigned.get(node, False)
                         and time.monotonic() - self._down_since[node]
@@ -550,3 +621,13 @@ class FailureDetector:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # the monitor thread failed to exit (a health probe
+                # wedged past its timeout, or a hook hung): surface it
+                # — chaos runs must not silently leak pollers. The
+                # /metrics gauge detector_thread_wedged rides this.
+                self.thread_wedged = True
+                import sys
+                print(f"filodb: FailureDetector monitor thread failed "
+                      f"to exit within 5s (peers={sorted(self.peers)})",
+                      file=sys.stderr)

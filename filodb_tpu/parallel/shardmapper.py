@@ -61,6 +61,16 @@ class ShardMapper:
         self._states: List[ShardState] = [ShardState()
                                           for _ in range(num_shards)]
         self._subscribers: List = []
+        # monotone topology epoch: bumped on every OWNERSHIP change
+        # (shard -> node edge rewired), not on status-only transitions.
+        # Carried in the health body and peer responses so stale-routing
+        # detection and the plan/results caches key off one counter
+        # (ShardMapper.scala versioning analogue).
+        self._epoch = 0
+
+    @property
+    def topology_epoch(self) -> int:
+        return self._epoch
 
     # -- hash-based routing (ShardMapper.scala:93-150) ---------------------
     def ingestion_shard(self, shard_key_hash: int, part_hash: int,
@@ -82,11 +92,14 @@ class ShardMapper:
     def update(self, shard: int, status: ShardStatus,
                node: Optional[str] = None, progress_pct: int = 0) -> None:
         st = self._states[shard]
+        prev_node = st.node
         st.status = status
         if node is not None:
             st.node = node
         if status in (ShardStatus.UNASSIGNED, ShardStatus.STOPPED):
             st.node = None
+        if st.node != prev_node:
+            self._epoch += 1        # ownership edge rewired
         st.progress_pct = progress_pct
         self._publish(ShardEvent(shard, status, st.node, progress_pct))
 

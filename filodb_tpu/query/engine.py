@@ -29,7 +29,7 @@ from filodb_tpu.query import logical as lp
 from filodb_tpu.query import rangefn as rf
 from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
                                     QueryStats, RangeParams, RawSeries,
-                                    ScalarResult)
+                                    ScalarResult, StaleRoutingError)
 
 METRIC_LABELS = ("_metric_", "__name__")
 
@@ -76,6 +76,11 @@ def _select_raw_series(shards, filters, start_ms, end_ms, column, stats,
             try:
                 got = fetch_raw(filters, start_ms, end_ms, column,
                                 full=full)
+            except StaleRoutingError:
+                # NOT a degraded-mode drop: the peer refused because
+                # our routing lags a handoff — the entry node must
+                # re-resolve and retry, never serve the partial world
+                raise
             except QueryError as e:
                 # degraded mode: with allow_partial the lost shard group
                 # drops out of the result and the response carries a

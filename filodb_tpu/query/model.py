@@ -137,6 +137,62 @@ class QueryError(Exception):
     pass
 
 
+class StaleRoutingError(QueryError):
+    """A peer was asked for shards it no longer serves: the caller's
+    routing table lags a planned shard handoff (topology epoch moved).
+
+    Raised server-side by ``leaf_select``/the pushdown expect-shards
+    check; the entry node catches it, applies the responder's ``owners``
+    hint to its ShardMapper, invalidates plan/results caches, and
+    re-materializes against fresh routing instead of returning the
+    stale (silently incomplete) response to the client.
+
+    ``__str__`` renders a machine-parseable sentinel so the error
+    round-trips losslessly through BOTH peer planes (the JSON control
+    plane's ``error`` string and the gRPC response's error field);
+    :meth:`parse` recovers it on the caller."""
+
+    PREFIX = "stale_routing:"
+
+    def __init__(self, owners=None, epoch: int = 0, node: str = "",
+                 detail: str = ""):
+        # shard -> owning node, per the RESPONDER's mapper (it is the
+        # former owner and witnessed the handoff)
+        self.owners = {int(k): v for k, v in (owners or {}).items()}
+        self.epoch = int(epoch)
+        self.node = node
+        self.detail = detail
+        super().__init__(self._render())
+
+    def _render(self) -> str:
+        import json as _json
+        return self.PREFIX + _json.dumps(
+            {"owners": {str(k): v for k, v in self.owners.items()},
+             "epoch": self.epoch, "node": self.node,
+             "detail": self.detail}, sort_keys=True)
+
+    def __str__(self) -> str:
+        return self._render()
+
+    @classmethod
+    def parse(cls, s) -> "Optional[StaleRoutingError]":
+        """Recover a StaleRoutingError from an error string carrying
+        the sentinel (possibly wrapped, e.g. ``remote node n: ...``);
+        None when the string is not one."""
+        import json as _json
+        if not isinstance(s, str):
+            return None
+        i = s.find(cls.PREFIX)
+        if i < 0:
+            return None
+        try:
+            d = _json.loads(s[i + len(cls.PREFIX):])
+        except ValueError:
+            return None
+        return cls(owners=d.get("owners"), epoch=d.get("epoch", 0),
+                   node=d.get("node", ""), detail=d.get("detail", ""))
+
+
 class QueryLimitError(QueryError):
     """A per-query guardrail tripped (ExecPlan.scala:46 enforceLimits —
     the reference aborts plans exceeding sample/series budgets)."""

@@ -39,7 +39,8 @@ from filodb_tpu.query import logical as lp
 from filodb_tpu.query.engine import (METRIC_LABELS, QueryEngine,
                                      select_raw_series)
 from filodb_tpu.query.model import (GridResult, QueryError, QueryLimits,
-                                    QueryStats, RangeParams)
+                                    QueryStats, RangeParams,
+                                    StaleRoutingError)
 
 # aggregations executable as mesh collectives (parallel/mesh.py MESH_AGGS)
 _MESH_AGGS = frozenset({"sum", "count", "avg", "min", "max", "group"})
@@ -267,6 +268,10 @@ class ConcatExec(ExecPlan):
                 self.deadline.check("ConcatExec fan-out")
             try:
                 outs.append(c.execute())
+            except StaleRoutingError:
+                # never absorbed into a partial result: the entry node
+                # re-resolves routing and retries the whole query
+                raise
             except QueryError as e:
                 if not self.allow_partial:
                     raise
@@ -555,7 +560,10 @@ class QueryPlanner:
                  allow_partial: bool = False,
                  resilience: Optional[object] = None,
                  no_result_cache: bool = False,
-                 local_dispatch: bool = False):
+                 local_dispatch: bool = False,
+                 handoff_sources: Optional[Dict[int, Tuple[str, str]]]
+                 = None,
+                 peer_watermarks: Optional[Dict[str, Dict]] = None):
         self.shards = list(shards)
         self._by_num = {getattr(s, "shard_num", i): s
                         for i, s in enumerate(self.shards)}
@@ -613,6 +621,16 @@ class QueryPlanner:
         # query sees — the results cache keys on this so the two can
         # never serve each other's extents
         self.local_dispatch = bool(local_dispatch)
+        # mid-handoff read redirect (parallel/membership.py): shard ->
+        # (previous owner node, base URL) for shards THIS node is
+        # adopting but has not finished replaying — reads route to the
+        # still-serving previous owner so no query sees a half-replayed
+        # copy (the make-before-break read path)
+        self.handoff_sources = dict(handoff_sources or {})
+        # gossiped per-peer ingest watermarks + backfill epochs (health
+        # body, ROADMAP 4a): stamped onto remote shard groups so the
+        # results cache's freshness horizon covers fan-out extents
+        self.peer_watermarks = dict(peer_watermarks or {})
         if resilience is None:
             from filodb_tpu.parallel.resilience import PeerResilience
             resilience = PeerResilience.default()
@@ -718,7 +736,24 @@ class QueryPlanner:
                 self.stats.warnings.append(
                     "shards " + ",".join(map(str, down))
                     + " are down with no replica; results are partial")
-        local = [self._by_num[n] for n in nums if n in self._by_num]
+        # make-before-break read path: shards mid-adoption here are
+        # served by their previous owner until the replay flips ACTIVE
+        redirect: Dict[Tuple[str, str], List[int]] = {}
+        redirected: set = set()
+        for n in nums:
+            if n in self._by_num and n in self.handoff_sources:
+                node, url = self.handoff_sources[n]
+                redirect.setdefault((node, url), []).append(n)
+                redirected.add(n)
+        local = [self._by_num[n] for n in nums
+                 if n in self._by_num and n not in redirected]
+        if redirect:
+            from filodb_tpu.parallel.cluster import RemoteShardGroup
+            for (node, url), group in sorted(redirect.items()):
+                grp = RemoteShardGroup(node, url, self.dataset, group,
+                                       **self._remote_kw())
+                self._stamp_peer_freshness(grp, node, group)
+                local.append(grp)
         if down and self.buddies:
             # failover: serve a down shard from the buddy replica of its
             # owning node (the replica ingests the same stream)
@@ -750,15 +785,43 @@ class QueryPlanner:
             gaddr = self.grpc_peers.get(node)
             if gaddr:
                 from filodb_tpu.grpcsvc import GrpcShardGroup
-                local.append(GrpcShardGroup(
+                grp = GrpcShardGroup(
                     node, gaddr, self.dataset, group,
                     http_fallback=self.peers.get(node),
-                    **self._remote_kw()))
+                    **self._remote_kw())
             else:
-                local.append(RemoteShardGroup(node, self.peers[node],
-                                              self.dataset, group,
-                                              **self._remote_kw()))
+                grp = RemoteShardGroup(node, self.peers[node],
+                                       self.dataset, group,
+                                       **self._remote_kw())
+            self._stamp_peer_freshness(grp, node, group)
+            local.append(grp)
         return local
+
+    def _stamp_peer_freshness(self, grp, node: str,
+                              group: Sequence[int]) -> None:
+        """Stamp a remote shard group with the peer's gossiped ingest
+        watermark + backfill-epoch sum (health-body exchange, ROADMAP
+        4a) when the gossip covers EVERY shard in the group. The
+        results cache reads these exactly like local shard attributes,
+        so fan-out extents gain the same settled-time bound local
+        extents have had — instead of leaning on the hot window alone.
+        Partial coverage stamps nothing (conservative: the group stays
+        invisible to the freshness horizon, as before)."""
+        pw = self.peer_watermarks.get(node)
+        if not pw:
+            return
+        wms = [pw.get("watermarks", {}).get(int(n)) for n in group]
+        if not wms or any(w is None for w in wms):
+            return
+        # -1 entries are never-ingested peer shards: they constrain
+        # nothing (mirroring local semantics) but are COUNTED OUT of
+        # the coverage, so the results cache sees the moment one of
+        # them starts ingesting even if the min never moves
+        nonneg = [int(w) for w in wms if int(w) >= 0]
+        grp.ingest_watermark_ms = min(nonneg) if nonneg else -1
+        grp.ingest_watermark_coverage = len(nonneg)
+        grp.ingest_backfill_epoch = sum(
+            int(pw.get("epochs", {}).get(int(n), 0)) for n in group)
 
     # -- materialization -------------------------------------------------
     def materialize(self, plan) -> ExecPlan:
@@ -868,12 +931,14 @@ class QueryPlanner:
                     stats=self.stats, local_only=True,
                     plan_wire=pw[0] if pw else b"",
                     http_fallback=self.peers.get(node),
+                    expect_shards=group,
                     **self._exec_kw()))
             elif node in self.peers:
                 from filodb_tpu.parallel.cluster import PromQlRemoteExec
                 children.append(PromQlRemoteExec(
                     query, start, step, end, node, self.peers[node],
                     self.dataset, stats=self.stats, local_only=True,
+                    expect_shards=group,
                     **self._exec_kw()))
             else:
                 return None
@@ -900,6 +965,7 @@ class QueryPlanner:
         g = shards[0]
         gaddr = self.grpc_peers.get(g.node_id)
         fw = self._forwardable(plan)
+        expect = list(g.shard_nums) if g.shard_nums is not None else None
         if gaddr:
             # gRPC peers take the STRUCTURAL plan tree (exec_plan.proto
             # capability): no dependence on the PromQL printer, so even
@@ -914,6 +980,7 @@ class QueryPlanner:
                     stats=self.stats, plan_wire=wire_bytes,
                     http_fallback=(self.peers.get(g.node_id)
                                    if fw else None),
+                    expect_shards=expect,
                     **self._exec_kw())
         if fw is None:
             return None
@@ -923,10 +990,12 @@ class QueryPlanner:
             return GrpcRemoteExec(query, start, step, end, g.node_id,
                                   gaddr, g.dataset, stats=self.stats,
                                   http_fallback=self.peers.get(g.node_id),
+                                  expect_shards=expect,
                                   **self._exec_kw())
         from filodb_tpu.parallel.cluster import PromQlRemoteExec
         return PromQlRemoteExec(query, start, step, end, g.node_id,
                                 g.base_url, g.dataset, stats=self.stats,
+                                expect_shards=expect,
                                 **self._exec_kw())
 
     def _plan_wire_of(self, plan):

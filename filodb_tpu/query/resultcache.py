@@ -118,14 +118,42 @@ def shards_watermark(shards: Sequence[object]) -> Optional[int]:
     watermark appears where the extent recorded none) drops overlapping
     extents — so late backfill into a previously empty shard
     invalidates instead of serving stale. Remote shards behind a
-    fan-out planner are invisible here: their staleness is bounded only
-    by the hot window, and their scope is fenced off by the dispatch-
-    scope key component."""
+    fan-out planner contribute when the planner stamped their group
+    with a GOSSIPED watermark (the health-body watermark exchange,
+    parallel/cluster.py peer_state_sink -> planner._stamp_peer_
+    freshness) — fan-out extents then carry the same settled-time
+    bound local ones do; unstamped groups stay invisible and only the
+    hot window bounds their staleness, with the dispatch-scope key
+    component fencing their scope."""
     wms = [getattr(s, "ingest_watermark_ms", None) for s in shards]
     wms = [w for w in wms if w is not None and w >= 0]
     if not wms:
         return None
     return int(min(wms))
+
+
+def watermark_coverage(shards: Sequence[object]) -> int:
+    """How many shards in the scope CONTRIBUTE a watermark (have
+    ingested). Cached alongside the extent and checked on lookup: a
+    never-ingested shard that starts ingesting can enter the min-set
+    at exactly the old minimum — the min itself then never moves and
+    the per-shard backfill epoch never bumps (an empty shard's first
+    series has no watermark to land below), yet the extent's steps now
+    miss that shard's series. A coverage CHANGE is that event made
+    visible, generalizing the single-shard "watermark appearing"
+    regression to mixed scopes (and, via the gossip-stamped
+    ``ingest_watermark_coverage`` on remote groups, to fan-out
+    scopes)."""
+    total = 0
+    for s in shards:
+        cov = getattr(s, "ingest_watermark_coverage", None)
+        if cov is not None:
+            total += int(cov)
+            continue
+        wm = getattr(s, "ingest_watermark_ms", None)
+        if wm is not None and wm >= 0:
+            total += 1
+    return total
 
 
 def shards_epoch(shards: Sequence[object]) -> int:
@@ -175,11 +203,13 @@ class CachedExtent:
     out column views, never copies of the whole matrix."""
 
     __slots__ = ("start_ms", "end_ms", "step_ms", "keys", "values",
-                 "watermark_ms", "epoch", "nbytes", "encode_memo")
+                 "watermark_ms", "epoch", "coverage", "nbytes",
+                 "encode_memo")
 
     def __init__(self, start_ms: int, end_ms: int, step_ms: int,
                  keys: List[Dict[str, str]], values: np.ndarray,
-                 watermark_ms: Optional[int], epoch: int = 0):
+                 watermark_ms: Optional[int], epoch: int = 0,
+                 coverage: int = 0):
         self.start_ms = int(start_ms)
         self.end_ms = int(end_ms)
         self.step_ms = int(step_ms)
@@ -188,6 +218,7 @@ class CachedExtent:
         self.values = values
         self.watermark_ms = watermark_ms
         self.epoch = int(epoch)     # shards' backfill-epoch sum at build
+        self.coverage = int(coverage)   # shards contributing a watermark
         self.nbytes = int(values.nbytes) + _KEY_OVERHEAD * len(keys) + 256
         # (start_ms, end_ms) -> rendered JSON result rows: repeat FULL
         # hits splice pre-encoded bytes (prom_json.matrix_bytes
@@ -240,7 +271,8 @@ class RangeSession:
     __slots__ = ("cache", "state", "plans", "key", "dataset", "query",
                  "start_ms", "step_ms", "end_ms", "full_plan",
                  "cached_steps", "computed_steps", "horizon_ms",
-                 "watermark_ms", "epoch", "_extent", "_cov")
+                 "watermark_ms", "epoch", "coverage", "_extent",
+                 "_cov")
 
     def __init__(self, cache: "ResultCache", state: str, plans: List,
                  full_plan, key, dataset: str, query: str,
@@ -248,6 +280,7 @@ class RangeSession:
                  horizon_ms: int = -1,
                  watermark_ms: Optional[int] = None,
                  epoch: int = 0,
+                 coverage: int = 0,
                  extent: Optional[CachedExtent] = None,
                  cov: Optional[Tuple[int, int]] = None,
                  cached_steps: int = 0, computed_steps: int = 0):
@@ -264,6 +297,7 @@ class RangeSession:
         self.horizon_ms = horizon_ms
         self.watermark_ms = watermark_ms
         self.epoch = epoch
+        self.coverage = coverage
         self._extent = extent
         self._cov = cov
         self.cached_steps = cached_steps
@@ -351,7 +385,7 @@ class RangeSession:
             return
         self.cache._store(self.key, res, self.start_ms, self.step_ms,
                           self.end_ms, self.horizon_ms,
-                          self.watermark_ms, self.epoch)
+                          self.watermark_ms, self.epoch, self.coverage)
 
 
 @guarded_by("_lock", "_entries", "_bytes", "hits", "partial_hits",
@@ -424,6 +458,7 @@ class ResultCache:
         shards = getattr(engine, "shards", ())
         wm = shards_watermark(shards)
         ep = shards_epoch(shards)
+        cov_n = watermark_coverage(shards)
         now_ms = int(self._clock() * 1000)
         horizon = now_ms - int(self.hot_window_ms)
         if wm is not None:
@@ -438,7 +473,7 @@ class ResultCache:
         # the grid's LAST step — coverage and span math run on the step
         # grid, not the raw end (which need not be step-aligned)
         grid_end = start_ms + (n_steps - 1) * step_ms
-        ext = self._lookup(key, wm, ep)
+        ext = self._lookup(key, wm, ep, cov_n)
         # floor the horizon onto this request's step grid
         hz_hi = start_ms + ((horizon - start_ms) // step_ms) * step_ms \
             if horizon >= start_ms else start_ms - step_ms
@@ -451,7 +486,7 @@ class ResultCache:
         if cov is None:
             return mk(self, "miss", [plan], plan, key, dataset, query,
                       start_ms, step_ms, end_ms, horizon_ms=horizon,
-                      watermark_ms=wm, epoch=ep,
+                      watermark_ms=wm, epoch=ep, coverage=cov_n,
                       computed_steps=n_steps)
         from filodb_tpu.query.engine import (lp_replace_range,
                                              uncovered_spans)
@@ -465,7 +500,7 @@ class ResultCache:
         return mk(self, "hit" if not spans else "partial", sub_plans,
                   plan, key, dataset, query, start_ms, step_ms, end_ms,
                   horizon_ms=horizon, watermark_ms=wm, epoch=ep,
-                  extent=ext, cov=cov,
+                  coverage=cov_n, extent=ext, cov=cov,
                   cached_steps=n_steps - computed,
                   computed_steps=computed)
 
@@ -481,11 +516,21 @@ class ResultCache:
         return ses.finish(engine, grids), ses
 
     # -- internals --------------------------------------------------------
-    def _lookup(self, key, wm: Optional[int],
-                epoch: int) -> Optional[CachedExtent]:
+    def _lookup(self, key, wm: Optional[int], epoch: int,
+                coverage: int = 0) -> Optional[CachedExtent]:
         with self._lock:
             ext = self._entries.get(key)
             if ext is None:
+                return None
+            if coverage != ext.coverage:
+                # a shard entered (or left) the watermark min-set: a
+                # previously-empty shard's first series can land at
+                # exactly the old minimum — min and epochs unmoved —
+                # yet dirty every cached step (the mixed-scope
+                # generalization of "watermark appearing")
+                self._bytes -= ext.nbytes
+                del self._entries[key]
+                self.watermark_invalidations += 1
                 return None
             if wm is not None and (ext.watermark_ms is None
                                    or wm < ext.watermark_ms):
@@ -513,7 +558,8 @@ class ResultCache:
 
     def _store(self, key, grid: GridResult, start_ms: int, step_ms: int,
                end_ms: int, horizon_ms: int,
-               watermark_ms: Optional[int], epoch: int = 0) -> None:
+               watermark_ms: Optional[int], epoch: int = 0,
+               coverage: int = 0) -> None:
         if key is None:
             return
         steps = grid.steps
@@ -525,7 +571,7 @@ class ResultCache:
         values = np.array(grid.values[:, :hi])      # own the memory
         ext = CachedExtent(int(steps[0]), int(steps[hi - 1]), step_ms,
                            [dict(k) for k in grid.keys], values,
-                           watermark_ms, epoch)
+                           watermark_ms, epoch, coverage)
         if ext.nbytes > self.max_bytes:
             return              # larger than the whole budget
         with self._lock:

@@ -174,6 +174,17 @@ DEFAULTS = {
     # (buddy failover still applies). Requires the shared data-dir /
     # stream-dir deployment (the Cassandra/Kafka analogue).
     "shard-reassign-grace-s": None,
+    # elastic membership (parallel/membership.py): POST /admin/drain
+    # walks this node's shards through planned make-before-break
+    # handoff, rejoining nodes defer shards a peer still serves and
+    # receive them back through the same protocol, and topology epochs
+    # + stale-routing retries keep routing/caches coherent. False falls
+    # back to the legacy on_node_up hard cutover.
+    "elastic-membership": True,
+    # per-shard handoff budget: flush + successor bootstrap/replay +
+    # ACTIVE advertisement must fit, or the shard rolls back to the
+    # draining owner
+    "handoff-timeout-s": 30.0,
 }
 
 
@@ -192,16 +203,23 @@ class FiloServer:
         self.backend = backend
         self.http: Optional[FiloHttpServer] = None
         self.streams: Dict[int, object] = {}
-        self.drivers: list = []
+        # ONE driver map for primary, adopted, and handed-back shards:
+        # the per-shard single-writer invariant is "at most one entry
+        # here, cluster-wide, per shard" (membership + chaos pin it)
+        self.drivers: Dict[int, object] = {}
         self.gateway = None
         self.detector = None
+        self.membership = None
         self.node_id: str = self.config["node-id"]
         self.owned_shards: list = []
-        # elastic-recovery bookkeeping: dead node -> shards THIS node
-        # adopted; shard -> replaying driver; node -> original assignment
+        # rejoin deferral: ordinal shards a peer still served at startup
+        # (it adopted them while this node was down); created only when
+        # the peer hands them back through /admin/adopt
+        self.deferred_shards: set = set()
+        # elastic-recovery bookkeeping: origin node -> shards THIS node
+        # adopted (crash or planned); node -> original assignment
         self._adopted: Dict[str, list] = {}
         self._reassign_lock = __import__("threading").Lock()
-        self._adopted_drivers: Dict[int, object] = {}
         self._original_shards: Dict[str, list] = {}
         self._gw_streams: Dict[int, object] = {}
 
@@ -291,7 +309,28 @@ class FiloServer:
             int(self.config.get("default-spread", 1)),
             dict(self.config.get("spread-overrides") or {}))
         self.card_trackers = {}
+        # rejoin deferral (parallel/membership.py): before creating a
+        # shard this node owns by ordinal, ask the peers whether one of
+        # them still SERVES it (it adopted the shard while this node
+        # was down). Deferred shards are neither created nor ingested
+        # here — the temporary owner hands them back make-before-break
+        # through /admin/adopt, closing the dual-writer window the
+        # legacy hard cutover opened.
+        peer_claims: Dict[int, tuple] = {}
+        elastic = bool(self.config.get("elastic-membership", True))
+        if num_nodes > 1 and elastic:
+            probe_peers = {k: v for k, v in
+                           dict(self.config.get("peers") or {}).items()
+                           if k != self.node_id}
+            if probe_peers:
+                from filodb_tpu.parallel.membership import \
+                    probe_peer_claims
+                peer_claims = probe_peer_claims(probe_peers,
+                                                self.owned_shards)
+        self.deferred_shards = set(peer_claims)
         for shard in self.owned_shards:
+            if shard in self.deferred_shards:
+                continue
             self._make_shard(shard)
         if num_nodes > 1:
             for i in range(num_nodes):
@@ -306,11 +345,21 @@ class FiloServer:
         # flips them DOWN when health checks fail. Own shards activate
         # immediately only without streaming (the ingestion drivers take
         # them through RECOVERY -> ACTIVE otherwise).
-        owned = set(self.owned_shards)
+        owned = set(self.owned_shards) - self.deferred_shards
         for shard in range(n) if num_nodes > 1 else self.owned_shards:
+            if shard in self.deferred_shards:
+                continue
             if shard in owned and streaming:
                 continue
             self.mapper.activate(shard)
+        # deferred shards are owned by their claimer until handed back
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        for shard, (claimer, st) in sorted(peer_claims.items()):
+            self.mapper.assign(shard, claimer)
+            try:
+                self.mapper.update(shard, ShardStatus(st), claimer)
+            except ValueError:
+                self.mapper.update(shard, ShardStatus.ACTIVE, claimer)
         if self.backend is None:
             try:
                 from filodb_tpu.query.batcher import MicroBatcher
@@ -393,6 +442,15 @@ class FiloServer:
             tracer=self._make_tracer(),
             slow_query_ms=float(self.config.get("slow-query-ms",
                                                 1000.0)))
+        # elastic membership: wire the planned-handoff coordinator
+        # BEFORE the HTTP edge starts serving, so an adopt/hand-back
+        # request arriving the instant the health endpoint answers
+        # (the peer's failure detector reacts fast) finds it ready
+        from filodb_tpu.parallel.membership import MembershipManager
+        self.membership = MembershipManager(
+            self, handoff_timeout_s=float(
+                self.config.get("handoff-timeout-s", 30.0)))
+        self.http.membership = self.membership
         self.http.start()
         self.grpc_server = None
         if self.config.get("grpc-port") is not None:
@@ -415,7 +473,8 @@ class FiloServer:
                                   else None),
                 on_node_down=self._on_node_down,
                 on_node_up=self._on_node_up,
-                grpc_peer_sink=self.http.grpc_peers).start()
+                grpc_peer_sink=self.http.grpc_peers,
+                peer_state_sink=self.http.peer_watermarks).start()
             # the health body advertises this node's down-view (quorum
             # input) and served-shard statuses (gossip) to its peers
             self.http.detector = self.detector
@@ -446,28 +505,19 @@ class FiloServer:
         (NewFiloServerMain.start: memstore, ingestion, http)."""
         import os
 
-        from filodb_tpu.ingest import IngestionDriver, LogIngestionStream
+        from filodb_tpu.ingest import LogIngestionStream
         stream_dir = self.config["stream-dir"]
         n = self.config["num-shards"]
         gc_s = float(self.config.get("stream-group-commit-ms", 0)) / 1000
         for shard in self.owned_shards:
+            if shard in self.deferred_shards:
+                continue        # a peer still serves it (single-writer)
             path = os.path.join(stream_dir, f"shard={shard}", "stream.log")
             self.streams[shard] = LogIngestionStream(
                 path, DEFAULT_SCHEMAS, group_commit_s=gc_s)
-        for shard in self.owned_shards:
-            drv = IngestionDriver(
-                self.store.get_shard(self.ref, shard), self.streams[shard],
-                mapper=self.mapper,
-                flush_every_records=self.config.get("flush-every-records"),
-                flush_interval_s=float(self.config.get("flush-interval-s",
-                                                       2.0)),
-                max_resident_samples=int(
-                    self.config.get("max-resident-samples", 0)),
-                ingest_batch_records=int(
-                    self.config.get("ingest-batch-records", 64)),
-                max_decode_cache_bytes=int(float(
-                    self.config.get("decode-cache-mb", 0)) * (1 << 20)))
-            self.drivers.append(drv.start())
+        for shard in sorted(self.streams):
+            self.drivers[shard] = self._make_driver(
+                shard, self.streams[shard]).start()
         if self.config.get("gateway-port") is not None:
             from filodb_tpu.gateway.server import GatewayServer
             # the gateway is the producer edge: in multi-node mode it
@@ -496,6 +546,45 @@ class FiloServer:
     # round-robin table; the shard's new owner bootstraps index + chunks
     # from the ColumnStore, replays the shared stream log from the
     # checkpoint watermark (RECOVERY with progress), then serves it.
+
+    def _make_driver(self, shard: int, stream):
+        """One ingestion driver, unstarted — shared by startup, crash
+        adoption, planned adoption, and handoff rollback so a shard's
+        writer is always built the same way."""
+        from filodb_tpu.ingest import IngestionDriver
+        return IngestionDriver(
+            self.store.get_shard(self.ref, shard), stream,
+            mapper=self.mapper,
+            flush_every_records=self.config.get("flush-every-records"),
+            flush_interval_s=float(self.config.get("flush-interval-s",
+                                                   2.0)),
+            max_resident_samples=int(
+                self.config.get("max-resident-samples", 0)),
+            ingest_batch_records=int(
+                self.config.get("ingest-batch-records", 64)),
+            max_decode_cache_bytes=int(float(
+                self.config.get("decode-cache-mb", 0)) * (1 << 20)))
+
+    def _restart_driver(self, shard: int) -> None:
+        """Handoff rollback: the successor never went ACTIVE — resume
+        ingesting locally from the checkpoint watermark (the recovery
+        replay covers the stopped window; the shard never left this
+        node's serving set)."""
+        if not self.config.get("stream-dir"):
+            return
+        import os
+
+        from filodb_tpu.ingest import LogIngestionStream
+        stream = self.streams.get(shard)
+        if stream is None:
+            path = os.path.join(self.config["stream-dir"],
+                                f"shard={shard}", "stream.log")
+            stream = LogIngestionStream(
+                path, DEFAULT_SCHEMAS,
+                group_commit_s=float(self.config.get(
+                    "stream-group-commit-ms", 0)) / 1000)
+            self.streams[shard] = stream
+        self.drivers[shard] = self._make_driver(shard, stream).start()
 
     def _on_node_down(self, node: str) -> None:
         import threading
@@ -527,6 +616,8 @@ class FiloServer:
         def adopt_all():
             # off the detector's poll thread: ColumnStore bootstrap can
             # take long, and health checks must keep running meanwhile
+            if self.membership is not None:
+                self.membership.note_crash_adoption()
             for sh in mine:
                 with self._reassign_lock:
                     if node not in self._adopted:
@@ -550,15 +641,23 @@ class FiloServer:
         import threading
 
         from filodb_tpu.parallel.shardmapper import ShardStatus
+        if self.membership is not None \
+                and self.config.get("elastic-membership", True):
+            # planned hand-back: each adopted shard replays and flips
+            # ACTIVE on its home node BEFORE this node releases it —
+            # the same make-before-break handoff the drain path runs,
+            # replacing the legacy hard cutover below
+            self.membership.handback(node)
+            return
         with self._reassign_lock:
             mine = self._adopted.pop(node, [])
-        # hand every reassigned shard back to its original owner (each
-        # node recomputes identically; the returned node re-bootstraps
-        # from the shared store + streams on its own startup). Held in
-        # RECOVERY until the owner's health body advertises the shard —
-        # the detector's status gossip promotes it, so queries carry a
-        # partial-result warning instead of silently missing data while
-        # the owner is still bootstrapping
+        # legacy hard cutover: hand every reassigned shard back to its
+        # original owner at once (each node recomputes identically; the
+        # returned node re-bootstraps from the shared store + streams
+        # on its own startup). Held in RECOVERY until the owner's
+        # health body advertises the shard — the detector's status
+        # gossip promotes it, so queries carry a partial-result warning
+        # instead of silently missing data while the owner bootstraps
         for sh in self._original_shards.get(node, []):
             self.mapper.assign(sh, node)
             self.mapper.update(sh, ShardStatus.RECOVERY, node)
@@ -572,19 +671,24 @@ class FiloServer:
             threading.Thread(target=release_all, daemon=True,
                              name=f"release-{node}").start()
 
-    def _adopt_shard(self, shard: int) -> None:
+    def _adopt_shard(self, shard: int, on_event=None,
+                     register=None) -> None:
         import os
 
         from filodb_tpu.parallel.shardmapper import ShardStatus
-        self.mapper.update(shard, ShardStatus.RECOVERY, self.node_id)
+        self.deferred_shards.discard(shard)   # hand-back on rejoin
         self._make_shard(shard)
         # publish the widened local shard list to the HTTP layer (atomic
-        # rebind; request handlers read the dict per request)
+        # rebind; request handlers read the dict per request) BEFORE
+        # claiming ownership in the mapper: a query planned in between
+        # would see "owned by me" with no local shard and silently drop
+        # it — published-but-unclaimed just routes to the previous
+        # owner (planned handoff) or stays DOWN (crash path) instead
         self.http.shards_by_dataset[self.ref.dataset] = \
             self.store.shards(self.ref)
+        self.mapper.update(shard, ShardStatus.RECOVERY, self.node_id)
         if self.config.get("stream-dir"):
-            from filodb_tpu.ingest import (IngestionDriver,
-                                           LogIngestionStream)
+            from filodb_tpu.ingest import LogIngestionStream
             path = os.path.join(self.config["stream-dir"],
                                 f"shard={shard}", "stream.log")
             stream = LogIngestionStream(
@@ -592,28 +696,33 @@ class FiloServer:
                 group_commit_s=float(self.config.get(
                     "stream-group-commit-ms", 0)) / 1000)
             self.streams[shard] = stream     # gateway routes to it too
-            drv = IngestionDriver(
-                self.store.get_shard(self.ref, shard), stream,
-                mapper=self.mapper,
-                flush_every_records=self.config.get("flush-every-records"),
-                flush_interval_s=float(
-                    self.config.get("flush-interval-s", 2.0)),
-                max_resident_samples=int(
-                    self.config.get("max-resident-samples", 0)),
-                ingest_batch_records=int(
-                    self.config.get("ingest-batch-records", 64)),
-                max_decode_cache_bytes=int(float(
-                    self.config.get("decode-cache-mb", 0)) * (1 << 20)))
-            self._adopted_drivers[shard] = drv.start()
+            drv = self._make_driver(shard, stream)
+            if on_event is not None:
+                # planned adoption: membership clears the read redirect
+                # when the replay completes (driver flips ACTIVE)
+                drv.on_event = on_event
+            if register is None:
+                self.drivers[shard] = drv
+                drv.start()
+            elif register(drv):
+                # planned adoption: registration is the single-writer
+                # gate — it is refused (atomically with the abort path)
+                # when the handoff was cancelled mid-bootstrap, so a
+                # writer never starts after the draining owner resumed
+                drv.start()
         else:
             self.mapper.update(shard, ShardStatus.ACTIVE, self.node_id)
 
     def _release_shard(self, shard: int) -> None:
-        drv = self._adopted_drivers.pop(shard, None)
+        drv = self.drivers.pop(shard, None)
         if drv is not None:
             drv.stop()
         stream = self.streams.pop(shard, None)
-        if stream is not None:
+        if stream is not None and self._gw_streams.get(shard) \
+                is not stream:
+            # close by OBJECT identity: if the local gateway publishes
+            # through this very stream (a draining node keeps its
+            # producer edge alive), only drop the consumer reference
             try:
                 stream.close()
             except OSError:
@@ -622,6 +731,8 @@ class FiloServer:
         self.store.remove_shard(self.ref, shard)
         self.http.shards_by_dataset[self.ref.dataset] = \
             self.store.shards(self.ref)
+        if self.membership is not None:
+            self.membership.note_release()
 
     def seed_dev_data(self, n_samples: int = 360, n_instances: int = 4,
                       start_ms: Optional[int] = None) -> int:
@@ -632,7 +743,7 @@ class FiloServer:
             DEFAULT_SCHEMAS, num_shards=self.config["num-shards"])
         if start_ms is None:
             start_ms = (int(time.time()) - n_samples * 10) * 1000
-        owned = set(self.owned_shards)
+        owned = set(self.owned_shards) - set(self.deferred_shards)
 
         def _mine(builders):
             return {sh: b for sh, b in builders.items() if sh in owned}
@@ -658,9 +769,7 @@ class FiloServer:
             self.detector.stop()
         if self.gateway is not None:
             self.gateway.stop()
-        for drv in list(self._adopted_drivers.values()):
-            drv.stop()
-        for drv in self.drivers:
+        for drv in list(self.drivers.values()):
             drv.stop()
         for stream in self.streams.values():
             stream.close()

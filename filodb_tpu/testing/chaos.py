@@ -19,6 +19,13 @@ Fault points wired in this build:
                         applied (ctx: shard, offset)
   * ``ingest.flush``  — ingest/driver.py before a group flush
                         (ctx: shard, group)
+  * ``handoff.adopt`` — parallel/membership.py before the adopt
+                        request of a planned handoff (ctx: shard, node)
+  * ``handoff.await`` — parallel/membership.py on each poll while the
+                        draining node waits for the successor to
+                        advertise ACTIVE (ctx: shard)
+  * ``handoff.transfer`` — parallel/membership.py before each peer
+                        ownership-transfer push (ctx: shard, node)
 
 Usage:
 

@@ -1,0 +1,532 @@
+"""Chaos scenarios for elastic membership: the ROADMAP tentpole target
+(rolling restart of a 2-node cluster with ZERO failed queries under
+continuous load, no dual-ingest window) plus mid-handoff faults — the
+successor dying mid-replay (shard falls back to the draining owner) and
+the draining node dying halfway (remaining shards take the normal
+crash/adoption path).
+
+The per-shard single-writer invariant is pinned by a sampler thread
+that continuously counts live ingestion drivers per shard across every
+node object in the cluster: the make-before-break protocol stops the
+old writer (with a final flush) strictly before the successor's
+replay driver starts."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.gateway.producer import TestTimeseriesProducer
+from filodb_tpu.ingest import LogIngestionStream
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+N_SAMPLES = 50
+N_INSTANCES = 4
+NUM_SHARDS = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    try:
+        with urllib.request.urlopen(url, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(port, path, body=None, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def _query(port, **extra):
+    """Settled-range query touching every shard: its result must be
+    byte-stable across the whole roll (data is fully ingested and
+    flushed before the restarts begin)."""
+    return _get(port, "/promql/timeseries/api/v1/query_range",
+                query='rate({_metric_=~'
+                      '"heap_usage|http_requests_total"}[5m])',
+                start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60,
+                **extra)
+
+
+def _result_data(body):
+    rows = [(tuple(sorted(r["metric"].items())), r.get("values"))
+            for r in body["data"]["result"]]
+    return sorted(rows)
+
+
+def _approx_equal(got, want, rtol=1e-5):
+    """Same series set, same step timestamps, values within float32
+    noise. Byte-exactness only holds per entry node within a stable
+    ownership regime (local series with live buffer tails evaluate
+    f64-spliced, remote-fetched ones ride the f32 device tiles); the
+    continuous-load invariant across regime changes is numeric
+    identity, with the exact-bytes pin applied entry-per-entry once
+    ownership is back to stable."""
+    if len(got) != len(want):
+        return False
+    for (gk, gv), (wk, wv) in zip(got, want):
+        if gk != wk or len(gv or ()) != len(wv or ()):
+            return False
+        for (gt, gx), (wt, wx) in zip(gv or (), wv or ()):
+            if gt != wt:
+                return False
+            fg, fw = float(gx), float(wx)
+            if abs(fg - fw) > rtol * max(abs(fg), abs(fw), 1e-9):
+                return False
+    return True
+
+
+def _shard_owners(port):
+    _, body = _get(port, "/api/v1/cluster/timeseries/status")
+    return {s["shard"]: (s["status"], s["address"])
+            for s in body["data"]}
+
+
+def _poll(fn, timeout=90.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+            if ok:
+                return last
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"poll timed out; last={last!r}")
+
+
+class _Producer:
+    """The test owns the WAL producer plane (the gateway analogue): one
+    writer stream per shard, independent of any node's lifecycle — a
+    rolling restart must not take the ingest edge down with a node."""
+
+    def __init__(self, stream_dir):
+        import os
+        self.prod = TestTimeseriesProducer(DEFAULT_SCHEMAS,
+                                           num_shards=NUM_SHARDS)
+        self.streams = {}
+        for sh in range(NUM_SHARDS):
+            path = os.path.join(stream_dir, f"shard={sh}", "stream.log")
+            self.streams[sh] = LogIngestionStream(path, DEFAULT_SCHEMAS)
+
+    def write(self, start_ms, n_samples):
+        for builders in (self.prod.gauges(start_ms, n_samples,
+                                          N_INSTANCES),
+                         self.prod.counters(start_ms, n_samples,
+                                            N_INSTANCES)):
+            for sh, b in builders.items():
+                for c in b.containers():
+                    self.streams[sh].append(c)
+
+    def close(self):
+        for s in self.streams.values():
+            s.close()
+
+
+class _Cluster:
+    """Two in-process streaming nodes over shared data/stream dirs."""
+
+    def __init__(self, tmp_path, grace=0.75):
+        self.ports = [_free_port(), _free_port()]
+        peers = {f"node{i}": f"http://127.0.0.1:{p}"
+                 for i, p in enumerate(self.ports)}
+        self.base = {
+            "num-shards": NUM_SHARDS, "num-nodes": 2, "peers": peers,
+            "data-dir": str(tmp_path / "data"),
+            "stream-dir": str(tmp_path / "streams"),
+            "flush-interval-s": 0.4,
+            # chunks close at 25 rows: the settled corpus (N_SAMPLES
+            # per series) is fully CHUNK-resident before the roll, so
+            # its evaluation path — and therefore its response bytes —
+            # is restart-stable. Buffer-resident tails are not (a
+            # rebuilt node reloads them as chunks), which is an
+            # engine-wide property independent of membership.
+            "max-chunks-size": 25,
+            "query-sample-limit": 0, "query-series-limit": 0,
+            "failure-detect-interval-s": 0.2,
+            "failure-detect-threshold": 2,
+            "shard-reassign-grace-s": grace,
+            "grpc-port": None,
+            "handoff-timeout-s": 25.0,
+        }
+        self.cfgs = [{**self.base, "node-ordinal": i,
+                      "port": self.ports[i]} for i in range(2)]
+        # live server objects by ordinal (None while a node is down);
+        # the single-writer sampler reads this
+        self.nodes = [FiloServer(dict(self.cfgs[0])).start(),
+                      FiloServer(dict(self.cfgs[1])).start()]
+
+    def stop(self):
+        for srv in self.nodes:
+            if srv is not None:
+                try:
+                    srv.stop()
+                except Exception:
+                    pass
+
+
+class _WriterSampler(threading.Thread):
+    """Continuously asserts the per-shard single-writer invariant: at
+    most one live ingestion driver per shard across all node objects."""
+
+    def __init__(self, cluster):
+        super().__init__(daemon=True)
+        self.cluster = cluster
+        self.violations = []
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.wait(0.01):
+            writers = {}
+            for srv in list(self.cluster.nodes):
+                if srv is None:
+                    continue
+                for sh, drv in list(srv.drivers.items()):
+                    th = getattr(drv, "_thread", None)
+                    if th is not None and th.is_alive() \
+                            and not drv._stop.is_set():
+                        writers.setdefault(sh, []).append(srv.node_id)
+            for sh, nodes in writers.items():
+                if len(nodes) > 1:
+                    self.violations.append((sh, tuple(nodes)))
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=5)
+
+
+class _QueryLoad(threading.Thread):
+    """Continuous query load against the currently-designated entry
+    node; records every failure and every response whose settled-range
+    data deviates from the golden answer."""
+
+    def __init__(self, entry, golden, allow_partial=False):
+        super().__init__(daemon=True)
+        self.entry = entry              # mutable {"port": int}
+        self.golden = golden
+        self.allow_partial = allow_partial
+        self.failures = []
+        self.mismatches = []
+        self.partials = 0
+        self.ok = 0
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.is_set():
+            port = self.entry["port"]
+            extra = {"allow_partial": "true"} if self.allow_partial \
+                else {}
+            try:
+                code, body = _query(port, **extra)
+            except (OSError, ValueError) as e:
+                if port != self.entry["port"]:
+                    continue            # raced an entry switch
+                self.failures.append(f"transport: {e}")
+                continue
+            if code != 200 or body.get("status") != "success":
+                self.failures.append((code, body.get("error")))
+                continue
+            if body.get("partial"):
+                if not self.allow_partial:
+                    self.failures.append(("partial", body.get(
+                        "warnings")))
+                else:
+                    self.partials += 1
+                continue
+            if not _approx_equal(_result_data(body), self.golden):
+                self.mismatches.append(len(body["data"]["result"]))
+                continue
+            self.ok += 1
+            # yield: the load must exercise the roll, not starve the
+            # ingest/replay threads of the GIL on small CI hosts
+            self._halt.wait(0.05)
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=30)
+
+
+def _wait_full_results(port, want_series, timeout=150):
+    def probe():
+        code, body = _query(port)
+        ok = (code == 200 and "partial" not in body
+              and len(body["data"]["result"]) >= want_series)
+        return ok, len(body["data"]["result"]) if code == 200 else code
+    return _poll(probe, timeout=timeout)
+
+
+def test_rolling_restart_zero_failed_queries(tmp_path):
+    """The acceptance scenario: restart BOTH nodes of a 2-node cluster
+    in sequence (drain -> stop -> rejoin -> hand back) under continuous
+    query load — zero failed queries, zero result deviations, and no
+    instant with two live writers for any shard."""
+    producer = _Producer(str(tmp_path / "streams"))
+    cluster = _Cluster(tmp_path)
+    sampler = _WriterSampler(cluster)
+    load = None
+    try:
+        producer.write(T0 * 1000, N_SAMPLES)
+        _wait_full_results(cluster.ports[0], 2 * N_INSTANCES)
+        _wait_full_results(cluster.ports[1], 2 * N_INSTANCES)
+        # settle: a FULL flush-group rotation moves every settled row
+        # into chunks, so the byte-identity reference is free of write-
+        # buffer tails (a restarted node reloads the same chunks from
+        # the ColumnStore; buffer splits are not restart-stable)
+        time.sleep(4.0)
+        code, full = _query(cluster.ports[0])
+        golden = _result_data(full)
+        # per-entry exact goldens: the "stable cluster" reference each
+        # node must reproduce byte-for-byte once the roll completes and
+        # ownership is back where it started
+        golden_exact = {p: _result_data(_query(p)[1])
+                        for p in cluster.ports}
+        assert _approx_equal(golden_exact[cluster.ports[1]], golden)
+
+        sampler.start()
+        entry = {"port": cluster.ports[0]}
+        load = _QueryLoad(entry, golden)
+        load.start()
+
+        for victim in (1, 0):
+            survivor = 1 - victim
+            entry["port"] = cluster.ports[survivor]
+            time.sleep(0.3)             # drain in-flight entry switches
+            srv = cluster.nodes[victim]
+            code, out = _post(srv.port, "/admin/drain")
+            assert code == 200 and out["data"]["failed"] == [], out
+            # live ingest continues mid-roll through the shared WAL
+            producer.write((T0 + (N_SAMPLES + victim * 10) * 10) * 1000,
+                           5)
+            srv.stop()
+            cluster.nodes[victim] = None
+            surv = cluster.nodes[survivor]
+            _poll(lambda: (surv.detector.is_down(f"node{victim}"),
+                           None))
+            _poll(lambda: (surv.detector._reassigned.get(
+                f"node{victim}", False), None), timeout=60)
+            # rejoin: deferral + planned hand-back
+            back = FiloServer(dict(cluster.cfgs[victim])).start()
+            cluster.nodes[victim] = back
+
+            def _handed_back():
+                st = _shard_owners(surv.port)
+                mine = [sh for sh in range(NUM_SHARDS)
+                        if sh in back.owned_shards]
+                ok = all(st[sh] == ("active", f"node{victim}")
+                         for sh in mine)
+                return ok, st
+            _poll(_handed_back, timeout=90)
+            # both entries serve the golden settled range again
+            for port in (surv.port, back.port):
+                code, body = _query(port)
+                assert code == 200
+                assert _approx_equal(_result_data(body), golden)
+
+        load.stop()
+        sampler.stop()
+        assert load.ok > 0
+        assert load.failures == [], load.failures[:5]
+        assert load.mismatches == [], load.mismatches[:5]
+        assert sampler.violations == [], sampler.violations[:5]
+
+        # ownership is back to the stable layout: each entry must now
+        # answer BYTE-IDENTICALLY to its own pre-roll stable-cluster
+        # response (the handoff RECOVERY windows are over)
+        for port in cluster.ports:
+            def _exact(p=port):
+                _, body = _query(p)
+                return (_result_data(body) == golden_exact[p],
+                        len(body["data"]["result"]))
+            _poll(_exact, timeout=60, interval=0.5)
+
+        # the mid-roll WAL appends were consumed by whoever owned each
+        # shard at the time: both nodes agree on the full tail
+        def _tails_agree():
+            c0, b0 = _get(
+                cluster.nodes[0].port,
+                "/promql/timeseries/api/v1/query_range",
+                query='{_metric_="heap_usage"}',
+                start=T0, end=T0 + (N_SAMPLES + 30) * 10, step=10)
+            c1, b1 = _get(
+                cluster.nodes[1].port,
+                "/promql/timeseries/api/v1/query_range",
+                query='{_metric_="heap_usage"}',
+                start=T0, end=T0 + (N_SAMPLES + 30) * 10, step=10)
+            if c0 != 200 or c1 != 200:
+                return False, (c0, c1)
+            d0, d1 = _result_data(b0), _result_data(b1)
+            n0 = sum(len(v or ()) for _, v in d0)
+            want = N_INSTANCES * (N_SAMPLES + 10)
+            return (_approx_equal(d0, d1) and n0 >= want), (n0, want)
+        _poll(_tails_agree, timeout=60)
+    finally:
+        if load is not None and load.is_alive():
+            load.stop()
+        if sampler.is_alive():
+            sampler.stop()
+        chaos.uninstall()
+        cluster.stop()
+        producer.close()
+
+
+def test_successor_unreachable_mid_handoff_falls_back(tmp_path):
+    """The successor never advertises ACTIVE (it died / is partitioned
+    mid-replay): the shard must FALL BACK to the draining owner — its
+    driver restarts from the checkpoint and queries keep answering in
+    full — never go dark or flip to a half-replayed copy."""
+    producer = _Producer(str(tmp_path / "streams"))
+    cluster = _Cluster(tmp_path)
+    sampler = _WriterSampler(cluster)
+    load = None
+    try:
+        producer.write(T0 * 1000, N_SAMPLES)
+        _wait_full_results(cluster.ports[0], 2 * N_INSTANCES)
+        _wait_full_results(cluster.ports[1], 2 * N_INSTANCES)
+        time.sleep(1.0)
+        golden = _result_data(_query(cluster.ports[0])[1])
+        a, b = cluster.nodes
+        node1_shards = sorted(sh for sh, (_, n) in
+                              _shard_owners(a.port).items()
+                              if n == "node1")
+
+        sampler.start()
+        entry = {"port": a.port}
+        load = _QueryLoad(entry, golden)
+        load.start()
+
+        inj = chaos.ChaosInjector()
+        inj.fail("handoff.await")       # successor looks dead forever
+        with inj:
+            code, out = _post(b.port, "/admin/drain",
+                              timeout="3")
+        assert code == 200
+        assert out["data"]["handed_off"] == [], out
+        assert {f["shard"] for f in out["data"]["failed"]} \
+            == set(node1_shards)
+
+        # rolled back: node1 still owns + ingests its shards, node0's
+        # half-adoption was aborted and its mapper claim restored
+        st = _shard_owners(b.port)
+        assert all(st[sh] == ("active", "node1")
+                   for sh in node1_shards), st
+        assert all(sh in b.drivers for sh in node1_shards)
+
+        def _aborted_on_a():
+            local = {s.shard_num for s in a.store.shards(a.ref)}
+            st_a = _shard_owners(a.port)
+            ok = all(sh not in local
+                     and st_a[sh] == ("active", "node1")
+                     for sh in node1_shards)
+            return ok, (sorted(local), st_a)
+        _poll(_aborted_on_a, timeout=30)
+        assert b.membership.metrics_snapshot()["handoffs_failed"] \
+            == len(node1_shards)
+
+        time.sleep(0.5)                 # keep the load running a beat
+        load.stop()
+        sampler.stop()
+        assert load.ok > 0
+        assert load.failures == [], load.failures[:5]
+        assert load.mismatches == [], load.mismatches[:5]
+        assert sampler.violations == []
+        # and the cluster still answers in full from both entries
+        for port in (a.port, b.port):
+            code, body = _query(port)
+            assert code == 200
+            assert _approx_equal(_result_data(body), golden)
+    finally:
+        if load is not None and load.is_alive():
+            load.stop()
+        if sampler.is_alive():
+            sampler.stop()
+        chaos.uninstall()
+        cluster.stop()
+        producer.close()
+
+
+def test_draining_node_dies_halfway_crash_path_covers_the_rest(
+        tmp_path):
+    """kill -9 halfway through a drain: the already-handed-off shard
+    stays with its new owner; the shards still on the dead node take
+    the normal crash/adoption path. Continuous load (allow_partial)
+    sees zero non-partial failures throughout."""
+    producer = _Producer(str(tmp_path / "streams"))
+    cluster = _Cluster(tmp_path, grace=0.6)
+    sampler = _WriterSampler(cluster)
+    load = None
+    try:
+        producer.write(T0 * 1000, N_SAMPLES)
+        _wait_full_results(cluster.ports[0], 2 * N_INSTANCES)
+        _wait_full_results(cluster.ports[1], 2 * N_INSTANCES)
+        time.sleep(1.0)
+        golden = _result_data(_query(cluster.ports[0])[1])
+        a, b = cluster.nodes
+        node1_shards = sorted(sh for sh, (_, n) in
+                              _shard_owners(a.port).items()
+                              if n == "node1")
+        assert len(node1_shards) >= 2
+
+        sampler.start()
+        entry = {"port": a.port}
+        load = _QueryLoad(entry, golden, allow_partial=True)
+        load.start()
+
+        # "halfway": hand ONE shard off cleanly...
+        ok, err = b.membership.handoff_shard(node1_shards[0], "node0")
+        assert ok, err
+        # ...then the draining node dies with the rest still on it
+        b.stop()
+        cluster.nodes[1] = None
+
+        # the crash path adopts the remaining shards on node0
+        def _recovered():
+            st = _shard_owners(a.port)
+            ok = all(s == "active" and n == "node0"
+                     for s, n in st.values())
+            return ok, st
+        _poll(_recovered, timeout=90)
+        _poll(lambda: ((lambda d: (_approx_equal(d, golden), len(d)))(
+            _result_data(_query(a.port)[1]))), timeout=90)
+
+        load.stop()
+        sampler.stop()
+        assert load.ok > 0
+        # zero NON-partial failures; partial responses during the
+        # detection/adoption window are the designed degraded mode
+        assert load.failures == [], load.failures[:5]
+        assert sampler.violations == []
+    finally:
+        if load is not None and load.is_alive():
+            load.stop()
+        if sampler.is_alive():
+            sampler.stop()
+        chaos.uninstall()
+        cluster.stop()
+        producer.close()

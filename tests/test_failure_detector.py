@@ -86,3 +86,112 @@ def test_two_node_cluster_fires_without_peers_to_consult():
         det.poll_once()
         time.sleep(0.01)
     assert fired == ["b"]
+
+
+def test_failing_on_node_up_hook_retries_instead_of_wedging():
+    """The recovery-path satellite: when on_node_up raises, the bare
+    except must NOT clear the reassignment flag — the hook retries on
+    the next poll, and meanwhile ownership is handed back at the
+    mapper level rather than wedging on the adopters forever."""
+    mapper, det, _ = _mk(["b"], {"b": [1]})
+    calls = []
+
+    def flaky_hook(node):
+        calls.append(node)
+        if len(calls) < 3:
+            raise RuntimeError("release hook failed")
+
+    det.on_node_up = flaky_hook
+    det._probe = lambda url: None
+    for _ in range(3):
+        det.poll_once()
+        time.sleep(0.01)
+    assert det.is_down("b") and det._reassigned["b"]
+    det._probe = lambda url: {"shards": {"1": "active"},
+                              "down_peers": []}
+    det.poll_once()
+    assert calls == ["b"]
+    # hook raised: flag kept (retry next poll), ownership handed back
+    # at the mapper level so it can't wedge
+    assert det._reassigned["b"]
+    assert mapper.status(1) is ShardStatus.ACTIVE
+    assert mapper.node_of(1) == "b"
+    det.poll_once()
+    assert calls == ["b", "b"] and det._reassigned["b"]
+    det.poll_once()                      # third call succeeds
+    assert calls == ["b", "b", "b"]
+    assert not det._reassigned["b"]
+    det.poll_once()                      # settled: no more hook calls
+    assert calls == ["b", "b", "b"]
+
+
+def test_down_flip_tracks_current_ownership_not_startup_assignment():
+    """A planned handoff moved shard 1 off node b before b died: the
+    down flip must follow the mapper's CURRENT assignment (nothing, for
+    a drained node) — not the startup shards_by_node table — or it
+    would clobber the new owner's shards DOWN."""
+    mapper, det, _ = _mk(["b", "c"], {"b": [1], "c": [2]})
+    mapper.assign(1, "c")                # planned handoff b -> c
+    mapper.update(1, ShardStatus.ACTIVE, "c")
+    det._probe = lambda url: (
+        None if "b" in url
+        else {"shards": {"1": "active", "2": "active"},
+              "down_peers": ["b"]})
+    for _ in range(3):
+        det.poll_once()
+        time.sleep(0.01)
+    assert det.is_down("b")
+    assert mapper.status(1) is ShardStatus.ACTIVE   # c's shard untouched
+    assert mapper.node_of(1) == "c"
+
+
+def test_bounce_before_reassignment_restores_only_owned_shards():
+    """A drained node that bounces (down then up before the grace
+    window) owns nothing: recovery must not hand its ORIGINAL shards
+    back to it at the mapper level."""
+    mapper, det, _ = _mk(["b"], {"b": [1]}, grace=None)
+    mapper.assign(1, "c")                # drained away before the bounce
+    mapper.update(1, ShardStatus.ACTIVE, "c")
+    det._probe = lambda url: None
+    det.poll_once()
+    assert det.is_down("b")
+    det._probe = lambda url: {"shards": {}, "down_peers": []}
+    det.poll_once()
+    assert not det.is_down("b")
+    assert mapper.node_of(1) == "c"      # not clobbered back to b
+
+
+def test_stop_surfaces_wedged_monitor_thread():
+    """stop() must check the join result: a monitor thread that fails
+    to exit is surfaced via thread_wedged (the detector_thread_wedged
+    gauge) instead of silently leaking a poller."""
+    mapper, det, _ = _mk(["b"], {"b": [1]})
+
+    class _Wedged:
+        def join(self, timeout=None):
+            pass
+
+        def is_alive(self):
+            return True
+
+    det._thread = _Wedged()
+    assert det.thread_wedged is False
+    det.stop()
+    assert det.thread_wedged is True
+
+
+def test_peer_state_sink_gossips_watermarks_and_drops_on_death():
+    sink = {}
+    mapper, det, _ = _mk(["b"], {"b": [1]}, peer_state_sink=sink)
+    det._probe = lambda url: {
+        "shards": {"1": "active"}, "down_peers": [],
+        "watermarks": {"1": 123_000}, "backfill_epochs": {"1": 2},
+        "topo_epoch": 7}
+    det.poll_once()
+    assert sink["b"]["watermarks"] == {1: 123_000}
+    assert sink["b"]["epochs"] == {1: 2}
+    assert sink["b"]["topo_epoch"] == 7
+    det._probe = lambda url: None
+    det.poll_once()
+    assert det.is_down("b")
+    assert "b" not in sink               # dead peers bound nothing

@@ -1,0 +1,355 @@
+"""Elastic membership e2e (parallel/membership.py): planned drain
+handoff, rejoin deferral + hand-back, topology-epoch cache coherence,
+and the stale-routing bounce/retry protocol.
+
+(Reference: coordinator/ShardManager.scala:28 — shard movement on node
+join/leave as a first-class planned operation; the crash path stays in
+tests/test_reassignment.py.)"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.query.model import StaleRoutingError
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+N_SAMPLES = 60
+N_INSTANCES = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    try:
+        with urllib.request.urlopen(url, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(port, path, body=None, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+def _query(port, **extra):
+    """Unpruned cross-node range query touching every shard."""
+    return _get(port, "/promql/timeseries/api/v1/query_range",
+                query='rate({_metric_=~'
+                      '"heap_usage|http_requests_total"}[5m])',
+                start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60,
+                **extra)
+
+
+def _result_data(body):
+    """Query payload minus per-request stats/timings, ordered by series
+    identity — the byte-identity comparison surface."""
+    rows = [(tuple(sorted(r["metric"].items())), r.get("values"))
+            for r in body["data"]["result"]]
+    return sorted(rows)
+
+
+def _shard_owners(port):
+    _, body = _get(port, "/api/v1/cluster/timeseries/status")
+    return {s["shard"]: (s["status"], s["address"])
+            for s in body["data"]}
+
+
+def _poll(fn, timeout=60.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+            if ok:
+                return last
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"poll timed out; last={last!r}")
+
+
+def _mk_cluster(tmp_path, n_nodes=2, num_shards=4, fd_interval=0.25,
+                grace=0.75, **extra):
+    ports = [_free_port() for _ in range(n_nodes)]
+    peers = {f"node{i}": f"http://127.0.0.1:{p}"
+             for i, p in enumerate(ports)}
+    base = {
+        "num-shards": num_shards, "num-nodes": n_nodes, "peers": peers,
+        "data-dir": str(tmp_path / "data"),
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "failure-detect-interval-s": fd_interval,
+        "failure-detect-threshold": 2,
+        "shard-reassign-grace-s": grace,
+        "grpc-port": None,          # deterministic HTTP plane
+        "handoff-timeout-s": 20.0,
+        **extra,
+    }
+    cfgs = [{**base, "node-ordinal": i, "port": ports[i]}
+            for i in range(n_nodes)]
+    servers = []
+    for cfg in cfgs:
+        srv = FiloServer(dict(cfg)).start()
+        srv.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                          start_ms=T0 * 1000)
+        servers.append(srv)
+    return servers, cfgs, ports
+
+
+def test_drain_hands_every_shard_off_and_results_stay_identical(tmp_path):
+    servers, cfgs, ports = _mk_cluster(tmp_path)
+    a, b = servers
+    try:
+        code, full = _query(a.port)
+        assert code == 200 and "partial" not in full
+        golden = _result_data(full)
+        node1_shards = sorted(sh for sh, (_, n) in
+                              _shard_owners(a.port).items()
+                              if n == "node1")
+        assert node1_shards
+
+        code, out = _post(b.port, "/admin/drain")
+        assert code == 200 and out["status"] == "success"
+        handed = {h["shard"] for h in out["data"]["handed_off"]}
+        assert handed == set(node1_shards), out
+        assert out["data"]["failed"] == []
+
+        # the drained node owns nothing; every shard active on node0
+        st_b = _shard_owners(b.port)
+        assert all(n != "node1" for _, n in st_b.values()), st_b
+        assert all(s == "active" for s, _ in st_b.values()), st_b
+        # both entry points serve the full pre-drain result set
+        for port in (a.port, b.port):
+            code, body = _query(port)
+            assert code == 200 and "partial" not in body
+            assert _result_data(body) == golden
+        # node0's mapper converged too (transfer push or its own adopt)
+        _poll(lambda: (all(n == "node0" for _, n in
+                           _shard_owners(a.port).values()), None))
+
+        # topology epoch moved on both nodes; the handoff counters and
+        # the epoch gauge ride /metrics
+        for srv in (a, b):
+            assert srv.mapper.topology_epoch > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{b.port}/metrics", timeout=30) as r:
+            mtx = r.read().decode()
+        assert "filodb_topology_epoch" in mtx
+        assert any(line.startswith("filodb_shard_handoff_completed_total")
+                   and int(float(line.split()[-1])) >= len(node1_shards)
+                   for line in mtx.splitlines())
+        assert 'filodb_shard_adoptions_total{kind="planned"}' in mtx
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def test_drain_without_peers_fails_cleanly(tmp_path):
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "data-dir": str(tmp_path / "d")}).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_raise(srv.port, "/admin/drain")
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def _post_raise(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_rejoin_defers_claimed_shards_and_receives_them_back(tmp_path):
+    servers, cfgs, ports = _mk_cluster(tmp_path)
+    a, b = servers
+    try:
+        code, full = _query(a.port)
+        golden = _result_data(full)
+        node1_shards = sorted(sh for sh, (_, n) in
+                              _shard_owners(a.port).items()
+                              if n == "node1")
+
+        _post(b.port, "/admin/drain")
+        b.stop()
+        servers[1] = None
+        # node0 must notice the death and mark node1 reassignable —
+        # the rejoin hand-back hook keys off that flag
+        _poll(lambda: (a.detector.is_down("node1"), None))
+        _poll(lambda: (a.detector._reassigned.get("node1", False),
+                       None), timeout=30)
+
+        b2 = FiloServer(dict(cfgs[1])).start()
+        servers[1] = b2
+        # startup deferral: node0 still serves node1's shards, so the
+        # restarted node must NOT have created them
+        assert set(b2.deferred_shards) | set(
+            sh for sh in node1_shards
+            if sh in {s.shard_num for s in b2.store.shards(b2.ref)}) \
+            == set(node1_shards)
+
+        # ...and the planned hand-back returns them: replayed, ACTIVE
+        # on node1, released by node0
+        def _handed_back():
+            st = _shard_owners(a.port)
+            ok = all(st[sh] == ("active", "node1")
+                     for sh in node1_shards)
+            return ok, st
+        _poll(_handed_back, timeout=60)
+        for port in (a.port, b2.port):
+            code, body = _query(port)
+            assert code == 200 and "partial" not in body
+            assert _result_data(body) == golden
+        # the hand-back rode the planned path, not the legacy cutover
+        snap = a.membership.metrics_snapshot()
+        assert snap["handoffs_completed"] >= len(node1_shards)
+    finally:
+        for srv in servers:
+            if srv is not None:
+                srv.stop()
+
+
+def test_stale_routing_bounce_is_never_returned_and_retries(tmp_path):
+    """Moves a shard between the plan-cache fill and the query: the
+    entry node's routing (and plan cache) still name the old owner,
+    which bounces stale_routing instead of answering with a silent
+    subset; the entry node rewires from the bounce's owner hint and
+    re-materializes — the client sees only the correct result."""
+    servers, cfgs, ports = _mk_cluster(
+        tmp_path, n_nodes=3, num_shards=4,
+        # detectors poll so slowly that gossip never updates node0's
+        # view during the test — only the bounce can fix its routing
+        fd_interval=300.0, grace=None)
+    a, b, c = servers
+    try:
+        code, full = _query(a.port)      # fills node0's plan cache
+        assert code == 200
+        golden = _result_data(full)
+        owners0 = _shard_owners(a.port)
+        node1_shards = sorted(sh for sh, (_, n) in owners0.items()
+                              if n == "node1")
+        assert node1_shards == [2, 3]
+
+        # drain node1 with the ownership-transfer push to node0
+        # suppressed: node0's mapper goes stale by construction
+        inj = chaos.ChaosInjector()
+        inj.fail("handoff.transfer",
+                 match=lambda ctx: ctx.get("node") == "node0")
+        with inj:
+            code, out = _post(b.port, "/admin/drain")
+            assert code == 200 and out["data"]["failed"] == [], out
+        by_new_owner = {h["shard"]: h["to"]
+                        for h in out["data"]["handed_off"]}
+        # round-robin over sorted survivors: node0 and node2 got one each
+        assert sorted(by_new_owner.values()) == ["node0", "node2"]
+        stale_shard = next(sh for sh, n in by_new_owner.items()
+                           if n == "node2")
+        # node0 genuinely has a stale view of that shard
+        assert _shard_owners(a.port)[stale_shard][1] == "node1"
+
+        before = a.http.stale_routing_retries
+        code, body = _query(a.port)
+        assert code == 200 and "partial" not in body
+        assert _result_data(body) == golden
+        assert a.http.stale_routing_retries > before
+        assert b.http.stale_routing_bounces >= 1
+        # the bounce's owner hint rewired node0's mapper
+        assert _shard_owners(a.port)[stale_shard][1] == "node2"
+        # and node0's caches were invalidated on the stale world
+        assert "stale-routing" in \
+            a.http.plan_cache.snapshot()["invalidations_by_reason"]
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def test_stale_routing_error_round_trips_through_strings():
+    e = StaleRoutingError(owners={3: "node2", 1: "node0"}, epoch=17,
+                          node="node1", detail="shards [3] moved")
+    wrapped = f"remote node node1: {e}"
+    back = StaleRoutingError.parse(wrapped)
+    assert back is not None
+    assert back.owners == {3: "node2", 1: "node0"}
+    assert back.epoch == 17 and back.node == "node1"
+    assert StaleRoutingError.parse("plain error") is None
+
+
+def test_leaf_endpoint_bounces_unserved_shards(tmp_path):
+    """POST /api/v1/raw asking for a shard this node does not serve
+    answers a stale_routing envelope (owners + epoch), never a silent
+    subset."""
+    servers, cfgs, ports = _mk_cluster(tmp_path, fd_interval=300.0,
+                                       grace=None)
+    a, b = servers
+    try:
+        node1_shards = sorted(sh for sh, (_, n) in
+                              _shard_owners(a.port).items()
+                              if n == "node1")
+        body = {"filters": [["_metric_", "eq", "heap_usage"]],
+                "start_ms": 0, "end_ms": 1 << 60, "column": None,
+                "shards": [node1_shards[0]]}
+        code, payload = _post(a.port, "/api/v1/raw/timeseries", body)
+        assert code == 200
+        assert payload["status"] == "error"
+        assert payload["errorType"] == "stale_routing"
+        assert payload["owners"] == {str(node1_shards[0]): "node1"}
+        assert "topo_epoch" in payload
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+def test_gossiped_watermarks_ride_health_and_stamp_remote_groups(
+        tmp_path):
+    """ROADMAP 4a: per-shard ingest watermarks + backfill epochs ride
+    the health body, the failure detector sinks them, and the planner
+    stamps remote shard groups so the results cache's freshness
+    horizon covers fan-out extents."""
+    servers, cfgs, ports = _mk_cluster(tmp_path, fd_interval=0.1,
+                                       grace=None)
+    a, b = servers
+    try:
+        _, health = _get(b.port, "/__health")
+        assert "watermarks" in health and "backfill_epochs" in health
+        assert "topo_epoch" in health
+        # wait for node0's detector to gossip node1's state
+        _poll(lambda: ("node1" in a.http.peer_watermarks,
+                       dict(a.http.peer_watermarks)))
+        planner = a.http.make_planner("timeseries")
+        shards = planner._resolve_shards(None)
+        remote = [s for s in shards if hasattr(s, "fetch_raw")]
+        assert remote
+        for grp in remote:
+            assert getattr(grp, "ingest_watermark_ms", None) is not None
+            assert hasattr(grp, "ingest_backfill_epoch")
+    finally:
+        for srv in servers:
+            srv.stop()

@@ -230,6 +230,28 @@ def test_watermark_appearing_invalidates():
     assert rc.snapshot()["watermark_invalidations"] == 1
 
 
+def test_watermark_coverage_change_invalidates():
+    """A never-ingested shard entering the watermark min-set at EXACTLY
+    the old minimum moves neither the min nor any backfill epoch (an
+    empty shard's first series has no watermark to land below) — yet
+    every cached step may now miss its series. The coverage count makes
+    that transition visible (PR 6: also the fan-out case via gossip-
+    stamped remote groups)."""
+    rc = ResultCache(max_bytes=1 << 20)
+    lagging = _Shard(-1)                    # never ingested
+    eng = _StubEngine(shards=[_Shard(1600 * 1000), lagging])
+    _run(rc, eng, 1000, 1600)
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "hit"
+    # first series lands with last == the other shard's watermark
+    lagging.ingest_watermark_ms = 1600 * 1000
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "miss"
+    assert rc.snapshot()["watermark_invalidations"] == 1
+    _, ses = _run(rc, eng, 1000, 1600)
+    assert ses.state == "hit"               # re-seeded, serves again
+
+
 def test_series_churn_computes_through():
     rc = ResultCache(max_bytes=1 << 20)
     eng = _StubEngine(n_series=1, shards=[_Shard(10_000_000 * 1000)])
