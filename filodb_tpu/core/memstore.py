@@ -36,7 +36,7 @@ import numpy as np
 
 from filodb_tpu.core.index import (END_TIME_INGESTING, ColumnFilter, TagIndex)
 from filodb_tpu.core.record import PartKey, RecordContainer
-from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.locks import guarded_by, single_writer
 from filodb_tpu.core.schemas import (ColumnType, DataSchema, DatasetRef,
                                      Schemas)
 from filodb_tpu.memory import histogram as bh
@@ -486,6 +486,8 @@ class TimeSeriesPartition:
         return len(self.chunks)
 
 
+@single_writer("per-shard counters: mutated only by the shard's owning "
+               "thread (ingest driver, or bootstrap strictly before it)")
 @dataclass
 class ShardStats:
     """Kamon-equivalent gauges (TimeSeriesShardStats, TimeSeriesShard.scala:41).
@@ -504,6 +506,12 @@ class ShardStats:
     quota_dropped_series: int = 0   # new series rejected by cardinality
 
 
+@single_writer("shard state is mutated only by the shard's single "
+               "writer (the per-shard ingest thread; adopt/crash "
+               "bootstrap runs strictly before the driver starts — the "
+               "membership protocol pins the handoff happens-before); "
+               "query threads read immutable snapshots, ODP page-in "
+               "rides _odp_lock")
 class TimeSeriesShard:
     """One shard: partKey -> partition map + tag index + flush groups
     (memstore/TimeSeriesShard.scala:258)."""
@@ -989,6 +997,10 @@ class TimeSeriesMemStore:
         self.schemas = schemas or DEFAULT_SCHEMAS
         self.column_store = column_store
         self._shards: Dict[DatasetRef, Dict[int, TimeSeriesShard]] = {}
+        # the shard MAP (not the shards) is mutated from concurrent
+        # adopt/release workers during elastic membership; reads stay
+        # lock-free GIL-atomic lookups
+        self._shards_lock = threading.Lock()
 
     def setup(self, ref: DatasetRef, shard_num: int, num_groups: int = 8,
               max_chunk_rows: int = DEFAULT_MAX_CHUNK_ROWS,
@@ -999,15 +1011,17 @@ class TimeSeriesMemStore:
         """Create one shard; with ``bootstrap`` (and a column store) the tag
         index + checkpoints are recovered from persistence
         (TimeSeriesMemStore.scala setup + IndexBootstrapper on startup)."""
-        shards = self._shards.setdefault(ref, {})
-        if shard_num in shards:
-            raise ValueError(f"shard {shard_num} already set up for {ref}")
         shard = TimeSeriesShard(ref, self.schemas, shard_num, num_groups,
                                 max_chunk_rows,
                                 column_store=self.column_store,
                                 card_tracker=card_tracker,
                                 flush_downsampler=flush_downsampler)
-        shards[shard_num] = shard
+        with self._shards_lock:
+            shards = self._shards.setdefault(ref, {})
+            if shard_num in shards:
+                raise ValueError(
+                    f"shard {shard_num} already set up for {ref}")
+            shards[shard_num] = shard
         if bootstrap:
             shard.bootstrap_from_store()
         return shard
@@ -1019,7 +1033,8 @@ class TimeSeriesMemStore:
         """Release a shard (elastic recovery hand-back: the adopter drops
         its copy when the original owner returns — ShardManager.scala
         stopShards semantics)."""
-        self._shards.get(ref, {}).pop(shard_num, None)
+        with self._shards_lock:
+            self._shards.get(ref, {}).pop(shard_num, None)
 
     def shards(self, ref: DatasetRef) -> List[TimeSeriesShard]:
         return [s for _, s in sorted(self._shards.get(ref, {}).items())]

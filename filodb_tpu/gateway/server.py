@@ -21,6 +21,7 @@ import threading
 from typing import Dict, List, Optional
 
 from filodb_tpu.core.record import RecordBuilder, ingestion_shard
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.core.record import PartKey
 from filodb_tpu.core.schemas import PartitionSchema, Schemas
 from filodb_tpu.gateway.influx import input_records, parse_line
@@ -50,6 +51,9 @@ class GatewayServer:
         gateway = self
 
         class Handler(socketserver.StreamRequestHandler):
+            # per-connection producer thread (ThreadingTCPServer spawn
+            # the AST engine cannot see)
+            @thread_root("gateway-producer")
             def handle(self):
                 builders: Dict[int, RecordBuilder] = {}
                 pending = 0

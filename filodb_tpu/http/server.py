@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from filodb_tpu.http import prom_json
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.obs.slowlog import InflightRegistry, SlowQueryLog
@@ -273,6 +274,12 @@ class FiloHttpServer:
         self.httpd.server_close()
 
     # -- request handling -------------------------------------------------
+    # the stdlib ThreadingHTTPServer spawns one handler thread per
+    # connection — the AST engine cannot see that spawn, so the entry
+    # point is marked explicitly: every query/admin path below runs on
+    # one of these roots concurrently with the ingest/detector/worker
+    # threads
+    @thread_root("http-handler")
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
         try:
             parsed = urllib.parse.urlparse(req.path)
@@ -390,6 +397,14 @@ class FiloHttpServer:
         if path == "/debug/queries":
             return 200, {"status": "success",
                          "data": self.inflight.snapshot()}
+        if path == "/debug/threads":
+            # the @thread_root inventory: every registered thread entry
+            # point with its module-qualified root function, the
+            # @guarded_by summary of its class, and which live threads
+            # currently run it (joined against threading.enumerate())
+            from filodb_tpu.lint.threads import thread_inventory
+            return 200, {"status": "success",
+                         "data": thread_inventory()}
         if path == "/debug/slow_queries":
             limit = int(self._param(qs, "limit", "50") or 50)
             return 200, {"status": "success",

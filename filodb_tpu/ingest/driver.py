@@ -32,6 +32,7 @@ from typing import Callable, List, Optional
 
 from filodb_tpu.core.memstore import TimeSeriesShard
 from filodb_tpu.ingest.stream import IngestionStream
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
 from filodb_tpu.testing import chaos
@@ -100,6 +101,7 @@ class IngestionDriver:
                                progress_pct=progress)
         self.on_event(self.shard.shard_num, status, progress)
 
+    @thread_root("ingest-shard")
     def _run(self) -> None:
         try:
             self._last_flush_t = time.monotonic()

@@ -9,7 +9,7 @@ historically lived in docstrings and in the builder's head; graftlint
 makes them *checked*, on every PR, on CPU-only CI, before anything
 touches a TPU.
 
-Three rule families (see the rule modules for the catalog):
+Rule families (see the rule modules for the catalog):
 
   * ``rules_kernel`` — kernel contracts: every ``pallas_call`` site
     carries a :func:`filodb_tpu.lint.contracts.kernel_contract`
@@ -25,12 +25,20 @@ Three rule families (see the rule modules for the catalog):
     :func:`filodb_tpu.lint.locks.guarded_by` annotations on shared
     fields, checked for access outside a ``with <lock>:`` scope and for
     blocking calls made while a lock is held.
+  * ``rules_concurrency`` — whole-program analysis over the project
+    call graph (``callgraph.py``): lock-order cycles + the canonical
+    order policy (``lockorder.py``), blocking primitives reachable
+    through call chains while a lock is held, and inference of shared
+    state mutated from >=2 thread roots (``threads.thread_root``) with
+    no common guard and no ``@guarded_by``.
 
 Mechanics:
 
   * run it: ``python -m filodb_tpu.lint`` (add ``--json`` for
-    machine-readable findings); tier-1 runs it via
-    ``tests/test_lint_clean.py``.
+    machine-readable findings, ``--changed-only`` for a git-diff-scoped
+    pre-commit run — the interprocedural rules still analyze the whole
+    graph but only findings anchored in changed files are reported);
+    tier-1 runs it via ``tests/test_lint_clean.py``.
   * suppress one finding: ``# graftlint: disable=<rule> (reason)`` on
     the offending line or the line above it. A reason string is
     required — bare disables are themselves a finding.
@@ -248,23 +256,32 @@ def _load_rule_modules() -> None:
     if _rule_modules_loaded:
         return
     _rule_modules_loaded = True
-    from filodb_tpu.lint import (rules_hot, rules_kernel,  # noqa: F401
-                                 rules_lock, rules_span, rules_trace)
+    from filodb_tpu.lint import (rules_concurrency,  # noqa: F401
+                                 rules_hot, rules_kernel, rules_lock,
+                                 rules_span, rules_trace)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
              baseline: Optional[frozenset] = None,
-             check_contracts: bool = True) -> LintResult:
+             check_contracts: bool = True,
+             report_only: Optional[frozenset] = None) -> LintResult:
     """Lint ``paths`` (default: the ``filodb_tpu`` package).
 
-    AST rules run per file; when ``check_contracts`` is set, files that
-    belong to an importable package are imported and every registered
+    AST rules run per file; the concurrency families run once over the
+    whole module set (the call graph is a project artifact); when
+    ``check_contracts`` is set, files that belong to an importable
+    package are imported and every registered
     :class:`~filodb_tpu.lint.contracts.KernelContract` they declare is
     verified (VMEM budget, tiling, grid bounds, span guard,
-    ``jax.eval_shape``)."""
+    ``jax.eval_shape``).
+
+    ``report_only`` (a set of repo-relative paths) keeps the analysis
+    whole-program but drops findings anchored outside those files —
+    the ``--changed-only`` pre-commit mode."""
     _load_rule_modules()
-    from filodb_tpu.lint import (rules_hot, rules_kernel, rules_lock,
-                                 rules_span, rules_trace)
+    from filodb_tpu.lint import (rules_concurrency, rules_hot,
+                                 rules_kernel, rules_lock, rules_span,
+                                 rules_trace)
     root = package_root()
     if paths is None:
         paths = [os.path.join(root, "filodb_tpu")]
@@ -295,6 +312,9 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
             raw.append((mod, f))
         for f in rules_lock.check_module(mod, lock_decls):
             raw.append((mod, f))
+    bymod_path = {m.relpath: m for m in mods}
+    for relpath, f in rules_concurrency.check_project(mods):
+        raw.append((bymod_path.get(relpath), f))
     if check_contracts:
         bymod = {m.relpath: m for m in mods}
         for relpath, f in rules_kernel.check_contracts(mods, root):
@@ -303,6 +323,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     for mod, f in raw:
         if mod is not None and _suppressed(mod, f):
             result.suppressed += 1
+        elif report_only is not None and f.path not in report_only:
+            continue
         elif f.key() in baseline:
             result.baselined.append(f)
         else:

@@ -8,9 +8,36 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+from typing import Optional
 
-from filodb_tpu.lint import load_baseline, rules, run_lint
+from filodb_tpu.lint import load_baseline, package_root, rules, run_lint
+
+
+def changed_files(base: Optional[str] = None) -> frozenset:
+    """Repo-relative .py paths changed vs ``base`` (default: the
+    working tree + index vs HEAD — the pre-commit view). Paths are
+    normalized to the forward-slash relpath form findings use."""
+    root = package_root()
+    out = set()
+    cmds = [["git", "diff", "--name-only", base]] if base else \
+        [["git", "diff", "--name-only", "HEAD"],
+         ["git", "diff", "--name-only", "--cached"],
+         ["git", "ls-files", "--others", "--exclude-standard"]]
+    for cmd in cmds:
+        try:
+            text = subprocess.run(
+                cmd, cwd=root, capture_output=True, text=True,
+                check=True).stdout
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        for line in text.splitlines():
+            line = line.strip().replace(os.sep, "/")
+            if line.endswith(".py"):
+                out.add(line)
+    return frozenset(out)
 
 
 def main(argv=None) -> int:
@@ -32,6 +59,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-contracts", action="store_true",
                     help="skip runtime kernel-contract verification "
                          "(AST rules only)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings anchored in files git "
+                         "considers changed (working tree + index vs "
+                         "HEAD, or vs --diff-base); the interprocedural "
+                         "rules still analyze the whole graph")
+    ap.add_argument("--diff-base", default=None,
+                    help="git ref to diff against for --changed-only "
+                         "(default: HEAD incl. staged + untracked)")
     ap.add_argument("--rules", action="store_true", dest="list_rules",
                     help="print the rule catalog and exit")
     args = ap.parse_args(argv)
@@ -41,9 +76,17 @@ def main(argv=None) -> int:
             print(f"{rid:26s} [{rule.family}/{rule.severity}] {rule.doc}")
         return 0
 
+    report_only = None
+    if args.changed_only:
+        report_only = changed_files(args.diff_base)
+        if not report_only:
+            print("graftlint: --changed-only: no changed .py files",
+                  file=sys.stderr)
+            return 0
     result = run_lint(args.paths or None,
                       baseline=load_baseline(args.baseline),
-                      check_contracts=not args.no_contracts)
+                      check_contracts=not args.no_contracts,
+                      report_only=report_only)
     if args.as_github:
         from filodb_tpu.lint.ci_annotations import github_annotations
         for line in github_annotations(result.to_json()):

@@ -1,0 +1,68 @@
+"""Canonical lock-acquisition order for the threaded subsystems.
+
+The interprocedural engine derives the *observed* acquisition-order
+graph (``CallGraph.order_pairs``); cycles in it are potential deadlocks
+(``lock-order-cycle``) regardless of this table. The table adds a
+*declared* order for the known hot locks: acquiring a lock that sits
+EARLIER in the list while holding a later one is a
+``lock-order-policy`` finding even before a second thread closes the
+cycle — the policy keeps the order consistent so cycles cannot form as
+the call graph grows.
+
+The order is coordinator-out-to-leaf (coarse, long-lived coordination
+locks first; fine, short-hold data locks last). A lock not listed here
+is unconstrained relative to the table (cycle detection still covers
+it). Lock names are the engine's canonical form: ``Cls.attr`` for
+instance locks (one order node per class — the standard abstraction),
+``pkg.mod:name`` for module globals.
+
+When real code needs a new nesting, EXTEND the table (and think about
+which side every existing pair lands on) rather than pragma-ing the
+finding: the table is the documentation of record for "which lock may
+I take while holding which".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+# outermost (acquired first) .. innermost (acquired last, leaf)
+CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
+    # node-level coordination: membership/handoff + crash reassignment
+    "MembershipManager._lock",
+    "FiloServer._reassign_lock",
+    # serving-path subsystem locks
+    "MicroBatcher._lock",
+    "BreakerRegistry._lock",
+    "PlanCache._lock",
+    "ResultCache._lock",
+    # memstore / device-store data locks
+    "TpuBackend._exec_lock",
+    "TpuBackend._tile_lock",
+    "TimeSeriesShard._odp_lock",
+    "TimeSeriesPartition._cache_lock",
+    # leaves: short-hold counters, per-object state, channel caches
+    "ShardMapper._lock",
+    "CircuitBreaker._lock",
+    "BatchStats._lock",
+    "SplitResult._lock",
+    "GrpcQueryServer._rpc_lock",
+    "LogIngestionStream._lock",
+    "MemoryIngestionStream._lock",
+    "filodb_tpu.grpcsvc.client:_channels_lock",
+)
+
+_INDEX: Dict[str, int] = {name: i
+                          for i, name in enumerate(CANONICAL_LOCK_ORDER)}
+
+
+def policy_violation(held: str, acquired: str) -> Optional[str]:
+    """Non-None (the message core) when acquiring ``acquired`` while
+    holding ``held`` contradicts the canonical order. Pairs with a lock
+    outside the table are unconstrained."""
+    hi, ai = _INDEX.get(held), _INDEX.get(acquired)
+    if hi is None or ai is None or ai > hi:
+        return None
+    return (f"acquires {acquired} (order #{ai}) while holding {held} "
+            f"(order #{hi}) — canonical order is outermost-first; see "
+            f"filodb_tpu/lint/lockorder.py")

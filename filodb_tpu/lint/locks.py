@@ -40,3 +40,22 @@ def guarded_by(lock: str, *fields: str):
         cls.__guarded_by__ = decls
         return cls
     return deco
+
+
+def single_writer(reason: str):
+    """Declare that instances of this class are mutated by at most ONE
+    thread at a time *by design* — the per-shard single-writer
+    invariant (a shard's index/partitions/stats are touched only by the
+    thread that currently owns the shard: its ingestion driver, or the
+    bootstrap that runs strictly before the driver starts; ownership
+    transfer is a happens-before edge the membership protocol pins).
+
+    graftlint's ``thread-unguarded-shared-state`` inference reasons per
+    (class, attribute) and cannot see that two roots mutate *disjoint
+    instances*; this declaration is the documented escape hatch — and,
+    like a pragma, it REQUIRES a reason string. Runtime-neutral: only
+    records ``cls.__single_writer__``."""
+    def deco(cls):
+        cls.__single_writer__ = reason
+        return cls
+    return deco

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from filodb_tpu.core.index import ColumnFilter
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
@@ -608,6 +609,7 @@ class FailureDetector:
                         except Exception:
                             pass     # keep the monitor thread alive
 
+    @thread_root("failure-detector")
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             self.poll_once()

@@ -60,6 +60,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.parallel.cluster import reassign_dead_shards
@@ -115,7 +116,7 @@ class MembershipManager:
     mutable state rides ``_lock``; the long-running protocol legs
     (flush, replay await, peer POSTs) run strictly outside it."""
 
-    def __init__(self, server,
+    def __init__(self, server: "FiloServer",  # noqa: F821 — typing only
                  handoff_timeout_s: float = 30.0,
                  poll_interval_s: float = 0.1):
         self.server = server
@@ -221,7 +222,11 @@ class MembershipManager:
                 # driver BEFORE the successor may start its own; the
                 # shard's resident state stays queryable
                 with obs_trace.span("drain-flush", shard=sh):
-                    drv = srv.drivers.pop(sh, None)
+                    # registry mutation rides the server's reassign
+                    # lock (shared with adopt/release workers); the
+                    # stop+flush below runs outside it
+                    with srv._reassign_lock:
+                        drv = srv.drivers.pop(sh, None)
                     had_driver = drv is not None
                     if drv is not None:
                         drv.stop(flush=True)
@@ -344,9 +349,12 @@ class MembershipManager:
             self.incoming[sh] = "bootstrapping"
             self.adoptions_planned += 1
         # reads for the shard route back to the still-serving previous
-        # owner while we replay (cleared when the driver goes ACTIVE)
+        # owner while we replay (cleared when the driver goes ACTIVE).
+        # All handoff_sources mutations ride _lock: the redirect map is
+        # shared with the adopt/reaper worker threads
         if from_node in srv.http.peers:
-            srv.http.handoff_sources[sh] = from_node
+            with self._lock:
+                srv.http.handoff_sources[sh] = from_node
         with srv._reassign_lock:
             # remember whose shard this was, so when the node returns
             # (rejoin after drain+restart) the same handoff primitive
@@ -367,9 +375,13 @@ class MembershipManager:
         with self._lock:
             if self.incoming.get(sh) == "cancelled":
                 return False
-            self.server.drivers[sh] = drv
+            # nested per the canonical order (membership gate outer,
+            # server registry inner — lint/lockorder.py)
+            with self.server._reassign_lock:
+                self.server.drivers[sh] = drv
         return True
 
+    @thread_root("adopt-shard")
     def _adopt_run(self, sh: int, from_node: str) -> None:
         srv = self.server
         try:
@@ -378,8 +390,8 @@ class MembershipManager:
                 register=lambda drv: self._register_adopt_driver(
                     sh, drv))
         except Exception:       # noqa: BLE001 — surfaced as shard ERROR
-            srv.http.handoff_sources.pop(sh, None)
             with self._lock:
+                srv.http.handoff_sources.pop(sh, None)
                 self.incoming.pop(sh, None)
             srv._release_shard(sh)
             srv.mapper.update(sh, ShardStatus.ERROR, srv.node_id)
@@ -409,10 +421,11 @@ class MembershipManager:
             else:
                 self._finalize_adopt(sh, cancelled=False)
 
+    @thread_root("abort-adopt-reaper")
     def _finalize_adopt(self, sh: int, cancelled: bool) -> None:
         srv = self.server
-        srv.http.handoff_sources.pop(sh, None)
         with self._lock:
+            srv.http.handoff_sources.pop(sh, None)
             self.incoming.pop(sh, None)
             owner = self._cancel_owner.pop(sh, None)
         if cancelled:
@@ -445,8 +458,9 @@ class MembershipManager:
             # either the replay driver registered first (we stop it
             # below) or the gate will refuse it — no interleaving
             # leaves a writer running after the rollback
-            drv = srv.drivers.pop(sh, None)
-        srv.http.handoff_sources.pop(sh, None)
+            with srv._reassign_lock:
+                drv = srv.drivers.pop(sh, None)
+            srv.http.handoff_sources.pop(sh, None)
         if drv is not None:
             drv.stop(flush=False)
             srv._release_shard(sh)
@@ -493,6 +507,7 @@ class MembershipManager:
         threading.Thread(target=self._handback_run, args=(node, mine),
                          daemon=True, name=f"handback-{node}").start()
 
+    @thread_root("handback")
     def _handback_run(self, node: str, shards: List[int]) -> None:
         for sh in sorted(shards):
             ok = False

@@ -14,10 +14,12 @@ with reference deployments.
 from __future__ import annotations
 
 import enum
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from filodb_tpu.core.record import ingestion_shard, query_shards
+from filodb_tpu.lint.locks import guarded_by
 
 
 class ShardStatus(enum.Enum):
@@ -51,6 +53,7 @@ class ShardEvent:
     progress_pct: int = 0
 
 
+@guarded_by("_lock", "_epoch")
 class ShardMapper:
     """numShards-entry shard→node table + status FSM (ShardMapper.scala:26)."""
 
@@ -67,10 +70,20 @@ class ShardMapper:
         # detection and the plan/results caches key off one counter
         # (ShardMapper.scala versioning analogue).
         self._epoch = 0
+        # serializes FSM transitions: update() is called concurrently
+        # from the failure-detector poll thread, per-shard ingestion
+        # driver threads, membership handoff workers, and HTTP admin
+        # threads — an unlocked `_epoch += 1` loses bumps under that
+        # interleaving, and a lost bump means two different topologies
+        # share an epoch (the plan/results caches would keep serving
+        # extents across an ownership rewire). Found by graftlint's
+        # thread-unguarded-shared-state inference.
+        self._lock = threading.Lock()
 
     @property
     def topology_epoch(self) -> int:
-        return self._epoch
+        with self._lock:
+            return self._epoch
 
     # -- hash-based routing (ShardMapper.scala:93-150) ---------------------
     def ingestion_shard(self, shard_key_hash: int, part_hash: int,
@@ -91,17 +104,23 @@ class ShardMapper:
 
     def update(self, shard: int, status: ShardStatus,
                node: Optional[str] = None, progress_pct: int = 0) -> None:
-        st = self._states[shard]
-        prev_node = st.node
-        st.status = status
-        if node is not None:
-            st.node = node
-        if status in (ShardStatus.UNASSIGNED, ShardStatus.STOPPED):
-            st.node = None
-        if st.node != prev_node:
-            self._epoch += 1        # ownership edge rewired
-        st.progress_pct = progress_pct
-        self._publish(ShardEvent(shard, status, st.node, progress_pct))
+        # the transition (multi-field ShardState write + epoch bump) is
+        # atomic under _lock; _publish runs OUTSIDE it — subscribers
+        # take their own locks (plan/results-cache invalidation) and
+        # must not nest under the mapper's
+        with self._lock:
+            st = self._states[shard]
+            prev_node = st.node
+            st.status = status
+            if node is not None:
+                st.node = node
+            if status in (ShardStatus.UNASSIGNED, ShardStatus.STOPPED):
+                st.node = None
+            if st.node != prev_node:
+                self._epoch += 1        # ownership edge rewired
+            st.progress_pct = progress_pct
+            ev = ShardEvent(shard, status, st.node, progress_pct)
+        self._publish(ev)
 
     def assign(self, shard: int, node: str) -> None:
         self.update(shard, ShardStatus.ASSIGNED, node)

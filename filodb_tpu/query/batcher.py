@@ -58,6 +58,7 @@ import numpy as np
 
 from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
 
@@ -93,6 +94,7 @@ class DeviceExecutor:
         finishing its current closure)."""
         return self._q.empty()
 
+    @thread_root("device-executor")
     def _run(self) -> None:
         while True:
             fn = self._q.get()

@@ -43,6 +43,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.hotpath import hot_path
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
@@ -849,6 +850,7 @@ class TpuBackend:
                 self._tile_refreshing.add(key)
             held = list(series)     # pin arrays until the rebuild lands
 
+            @thread_root("tile-refresh")
             def refresh():
                 try:
                     fresh = self._build_tile_entry(held, use_snap)

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 from filodb_tpu.core.memstore import TimeSeriesMemStore
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
 from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.parallel.shardmapper import (ShardMapper,
                                              assign_shards_evenly,
                                              shards_for_ordinal)
@@ -242,7 +243,13 @@ class FiloServer:
                 self.config.get("card-quotas") or {}).items():
             tracker.set_quota([p for p in pfx.split(",") if p],
                               int(quota))
-        self.card_trackers[shard] = tracker
+        # shard-registry maps (card_trackers/streams/drivers + the HTTP
+        # shard-list publish) are mutated from adopt/release/handback
+        # worker threads concurrently — every mutation rides
+        # _reassign_lock (graftlint thread-unguarded-shared-state);
+        # reads stay lock-free GIL-atomic snapshots
+        with self._reassign_lock:
+            self.card_trackers[shard] = tracker
         fds = None
         if self.config.get("flush-downsample") \
                 and self.store.column_store is not None:
@@ -513,11 +520,15 @@ class FiloServer:
             if shard in self.deferred_shards:
                 continue        # a peer still serves it (single-writer)
             path = os.path.join(stream_dir, f"shard={shard}", "stream.log")
-            self.streams[shard] = LogIngestionStream(
+            stream = LogIngestionStream(
                 path, DEFAULT_SCHEMAS, group_commit_s=gc_s)
+            with self._reassign_lock:
+                self.streams[shard] = stream
         for shard in sorted(self.streams):
-            self.drivers[shard] = self._make_driver(
-                shard, self.streams[shard]).start()
+            drv = self._make_driver(shard, self.streams[shard])
+            with self._reassign_lock:
+                self.drivers[shard] = drv
+            drv.start()
         if self.config.get("gateway-port") is not None:
             from filodb_tpu.gateway.server import GatewayServer
             # the gateway is the producer edge: in multi-node mode it
@@ -583,8 +594,12 @@ class FiloServer:
                 path, DEFAULT_SCHEMAS,
                 group_commit_s=float(self.config.get(
                     "stream-group-commit-ms", 0)) / 1000)
-            self.streams[shard] = stream
-        self.drivers[shard] = self._make_driver(shard, stream).start()
+            with self._reassign_lock:
+                self.streams[shard] = stream
+        drv = self._make_driver(shard, stream)
+        with self._reassign_lock:
+            self.drivers[shard] = drv
+        drv.start()
 
     def _on_node_down(self, node: str) -> None:
         import threading
@@ -613,6 +628,7 @@ class FiloServer:
                 # silently missing the bootstrapping shard
                 self.mapper.update(sh, ShardStatus.RECOVERY, owner)
 
+        @thread_root("crash-adopt")
         def adopt_all():
             # off the detector's poll thread: ColumnStore bootstrap can
             # take long, and health checks must keep running meanwhile
@@ -662,6 +678,7 @@ class FiloServer:
             self.mapper.assign(sh, node)
             self.mapper.update(sh, ShardStatus.RECOVERY, node)
 
+        @thread_root("crash-release")
         def release_all():
             # off the poll thread: driver stops join + flush (the same
             # reason adoption runs in the background)
@@ -676,7 +693,8 @@ class FiloServer:
         import os
 
         from filodb_tpu.parallel.shardmapper import ShardStatus
-        self.deferred_shards.discard(shard)   # hand-back on rejoin
+        with self._reassign_lock:
+            self.deferred_shards.discard(shard)   # hand-back on rejoin
         self._make_shard(shard)
         # publish the widened local shard list to the HTTP layer (atomic
         # rebind; request handlers read the dict per request) BEFORE
@@ -684,8 +702,9 @@ class FiloServer:
         # would see "owned by me" with no local shard and silently drop
         # it — published-but-unclaimed just routes to the previous
         # owner (planned handoff) or stays DOWN (crash path) instead
-        self.http.shards_by_dataset[self.ref.dataset] = \
-            self.store.shards(self.ref)
+        with self._reassign_lock:
+            self.http.shards_by_dataset[self.ref.dataset] = \
+                self.store.shards(self.ref)
         self.mapper.update(shard, ShardStatus.RECOVERY, self.node_id)
         if self.config.get("stream-dir"):
             from filodb_tpu.ingest import LogIngestionStream
@@ -695,14 +714,16 @@ class FiloServer:
                 path, DEFAULT_SCHEMAS,
                 group_commit_s=float(self.config.get(
                     "stream-group-commit-ms", 0)) / 1000)
-            self.streams[shard] = stream     # gateway routes to it too
+            with self._reassign_lock:
+                self.streams[shard] = stream  # gateway routes to it too
             drv = self._make_driver(shard, stream)
             if on_event is not None:
                 # planned adoption: membership clears the read redirect
                 # when the replay completes (driver flips ACTIVE)
                 drv.on_event = on_event
             if register is None:
-                self.drivers[shard] = drv
+                with self._reassign_lock:
+                    self.drivers[shard] = drv
                 drv.start()
             elif register(drv):
                 # planned adoption: registration is the single-writer
@@ -714,10 +735,15 @@ class FiloServer:
             self.mapper.update(shard, ShardStatus.ACTIVE, self.node_id)
 
     def _release_shard(self, shard: int) -> None:
-        drv = self.drivers.pop(shard, None)
+        # registry pops ride _reassign_lock; the blocking teardown
+        # (driver stop() joins its thread, stream close() syncs the
+        # log tail) runs strictly outside it
+        with self._reassign_lock:
+            drv = self.drivers.pop(shard, None)
+            stream = self.streams.pop(shard, None)
+            self.card_trackers.pop(shard, None)
         if drv is not None:
             drv.stop()
-        stream = self.streams.pop(shard, None)
         if stream is not None and self._gw_streams.get(shard) \
                 is not stream:
             # close by OBJECT identity: if the local gateway publishes
@@ -727,10 +753,10 @@ class FiloServer:
                 stream.close()
             except OSError:
                 pass
-        self.card_trackers.pop(shard, None)
         self.store.remove_shard(self.ref, shard)
-        self.http.shards_by_dataset[self.ref.dataset] = \
-            self.store.shards(self.ref)
+        with self._reassign_lock:
+            self.http.shards_by_dataset[self.ref.dataset] = \
+                self.store.shards(self.ref)
         if self.membership is not None:
             self.membership.note_release()
 

@@ -22,6 +22,7 @@ import urllib.request
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
 from filodb_tpu.gateway.producer import TestTimeseriesProducer
 from filodb_tpu.ingest import LogIngestionStream
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.standalone.server import FiloServer
 from filodb_tpu.testing import chaos
 
@@ -202,6 +203,7 @@ class _WriterSampler(threading.Thread):
         self.violations = []
         self._halt = threading.Event()
 
+    @thread_root("chaos-writer-sampler")
     def run(self):
         while not self._halt.wait(0.01):
             writers = {}
@@ -238,6 +240,7 @@ class _QueryLoad(threading.Thread):
         self.ok = 0
         self._halt = threading.Event()
 
+    @thread_root("chaos-query-load")
     def run(self):
         while not self._halt.is_set():
             port = self.entry["port"]

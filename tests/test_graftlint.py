@@ -637,3 +637,448 @@ def test_span_discipline_hot_path_formatting(tmp_path):
     # pragma with a reason suppresses
     res = lint_src(tmp_path, SPAN_HOT_FORMAT_PRAGMA)
     assert not res.findings and res.suppressed == 1
+
+
+# -- interprocedural concurrency engine (graftlint v2) -----------------------
+#
+# Fixture convention unchanged: every violation snippet trips exactly the
+# named rule; every clean twin passes. The engine fixtures additionally
+# poke the call-graph internals (construction, roots, propagation).
+
+def _build_cg(tmp_path, src, name="fix_cg.py"):
+    from filodb_tpu.lint import callgraph as cgm
+    from filodb_tpu.lint import load_module
+    p = tmp_path / name
+    p.write_text(src)
+    mod = load_module(str(p), root=str(tmp_path))
+    assert mod is not None
+    return cgm.build([mod])
+
+
+CG_CONSTRUCTION = """
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self._step_once()
+
+    def _step_once(self):
+        def inner():
+            self.items["k"] = 1
+        inner()
+
+def helper():
+    w = Worker()
+    w.start()
+"""
+
+
+def test_callgraph_construction(tmp_path):
+    cg = _build_cg(tmp_path, CG_CONSTRUCTION)
+    # methods, closures, module functions all indexed
+    assert "fix_cg:Worker._run" in cg.funcs
+    assert "fix_cg:Worker._step_once.<locals>.inner" in cg.funcs
+    assert "fix_cg:helper" in cg.funcs
+    # Thread(target=self._run) (chained .start()) makes _run a root
+    assert "fix_cg:Worker._run" in cg.roots
+    # method edge _run -> _step_once, closure edge _step_once -> inner
+    run_sites = cg.funcs["fix_cg:Worker._run"].sites
+    assert any("fix_cg:Worker._step_once" in s.callees for s in run_sites)
+    step_sites = cg.funcs["fix_cg:Worker._step_once"].sites
+    assert any("fix_cg:Worker._step_once.<locals>.inner" in s.callees
+               for s in step_sites)
+    # constructor-typed local: w = Worker() resolves w.start()
+    helper_sites = cg.funcs["fix_cg:helper"].sites
+    assert any("fix_cg:Worker.start" in s.callees for s in helper_sites)
+    # the closure's subscript store is attributed to Worker.items and
+    # reachable from the thread root
+    inner = cg.funcs["fix_cg:Worker._step_once.<locals>.inner"]
+    assert [m.target for m in inner.mutations] == ["Worker.items"]
+    assert inner.key in cg.reachable_from["fix_cg:Worker._run"]
+
+
+LOCK_ORDER_CYCLE = """
+import threading
+
+class PairA:
+    def __init__(self):
+        self._la = threading.Lock()
+        self.peer = PairB()
+
+    def forward(self):
+        with self._la:
+            self.peer.grab_b()
+
+    def grab_a(self):
+        with self._la:
+            pass
+
+class PairB:
+    def __init__(self):
+        self._lb = threading.Lock()
+        self.back = PairA()
+
+    def grab_b(self):
+        with self._lb:
+            pass
+
+    def reverse(self):
+        with self._lb:
+            self.back.grab_a()
+"""
+
+LOCK_ORDER_CYCLE_CLEAN = """
+import threading
+
+class PairA:
+    def __init__(self):
+        self._la = threading.Lock()
+        self.peer = PairB()
+
+    def forward(self):
+        with self._la:
+            self.peer.grab_b()
+
+    def also_forward(self):
+        with self._la:
+            self.peer.grab_b()      # same direction: no cycle
+
+class PairB:
+    def __init__(self):
+        self._lb = threading.Lock()
+
+    def grab_b(self):
+        with self._lb:
+            pass
+"""
+
+
+def test_lock_order_cycle(tmp_path):
+    # the two-lock deadlock: A held-then-B on one path, B held-then-A
+    # on another — the classic cross-thread deadlock shape, visible
+    # only interprocedurally (each function alone is innocent)
+    assert rules_of(lint_src(tmp_path, LOCK_ORDER_CYCLE)) \
+        == ["lock-order-cycle"]
+    assert not lint_src(tmp_path, LOCK_ORDER_CYCLE_CLEAN).findings
+
+
+LOCK_ORDER_POLICY = """
+import threading
+
+class MembershipManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mm = MembershipManager()
+
+    def bad(self):
+        with self._lock:
+            with self.mm._lock:
+                pass
+"""
+
+LOCK_ORDER_POLICY_CLEAN = """
+import threading
+
+class MembershipManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mb = MicroBatcher()
+
+    def good(self):
+        with self._lock:
+            with self.mb._lock:     # outer #0 before inner #2
+                pass
+
+class MicroBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+"""
+
+
+def test_lock_order_policy(tmp_path):
+    # canonical order (lint/lockorder.py): MembershipManager._lock is
+    # outermost — acquiring it while holding the batcher lock violates
+    # the declared order even though no cycle exists yet
+    assert rules_of(lint_src(tmp_path, LOCK_ORDER_POLICY)) \
+        == ["lock-order-policy"]
+    assert not lint_src(tmp_path, LOCK_ORDER_POLICY_CLEAN).findings
+
+
+DEEP_BLOCKING = """
+import threading
+import urllib.request
+
+class Client:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def serve(self):
+        with self._lock:
+            self._refresh_state()
+
+    def _refresh_state(self):
+        self._fetch_peer()
+
+    def _fetch_peer(self):
+        return urllib.request.urlopen("http://peer/health")
+"""
+
+DEEP_BLOCKING_CLEAN = """
+import threading
+import urllib.request
+
+class Client:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def serve(self):
+        with self._lock:
+            want = True
+        if want:
+            self._refresh_state()       # RPC strictly outside the lock
+
+    def _refresh_state(self):
+        self._fetch_peer()
+
+    def _fetch_peer(self):
+        return urllib.request.urlopen("http://peer/health")
+"""
+
+
+def test_deep_blocking_under_lock(tmp_path):
+    # the peer RPC is 3 frames below the lock acquisition — the
+    # per-function rule cannot see it; the chain is in the message
+    res = lint_src(tmp_path, DEEP_BLOCKING)
+    assert rules_of(res) == ["lock-blocking-reachable"]
+    assert "urllib.urlopen" in res.findings[0].message
+    assert "_fetch_peer" in res.findings[0].message
+    assert not lint_src(tmp_path, DEEP_BLOCKING_CLEAN).findings
+
+
+UNGUARDED_SHARED = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self.counts = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+        threading.Thread(target=self._flusher, daemon=True).start()
+
+    def _poller(self):
+        self.counts.setdefault("a", 0)
+
+    def _flusher(self):
+        self.counts.pop("a", None)
+"""
+
+UNGUARDED_SHARED_LOCKED = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+        threading.Thread(target=self._flusher, daemon=True).start()
+
+    def _poller(self):
+        with self._lock:
+            self.counts.setdefault("a", 0)
+
+    def _flusher(self):
+        with self._lock:
+            self.counts.pop("a", None)
+"""
+
+UNGUARDED_SHARED_DECLARED = """
+import threading
+from filodb_tpu.lint.locks import guarded_by
+
+@guarded_by("_lock", "counts")
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+
+    def _poller(self):
+        with self._lock:
+            self.counts.setdefault("a", 0)
+
+    def _flusher_locked(self):
+        self.counts.pop("a", None)
+"""
+
+UNGUARDED_SINGLE_WRITER = """
+import threading
+from filodb_tpu.lint.locks import single_writer
+
+@single_writer("instances are owned by one worker at a time")
+class Svc:
+    def __init__(self):
+        self.counts = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+        threading.Thread(target=self._flusher, daemon=True).start()
+
+    def _poller(self):
+        self.counts.setdefault("a", 0)
+
+    def _flusher(self):
+        self.counts.pop("a", None)
+"""
+
+UNGUARDED_ATOMIC_REBIND = """
+import threading
+
+class Svc:
+    def __init__(self):
+        self.latest = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+        threading.Thread(target=self._flusher, daemon=True).start()
+
+    def _poller(self):
+        self.latest = {"a": 1}      # GIL-atomic publish: fine
+
+    def _flusher(self):
+        self.latest = {}
+"""
+
+UNGUARDED_THREAD_ROOT_MARKER = """
+import threading
+from filodb_tpu.lint.threads import thread_root
+
+class Svc:
+    def __init__(self):
+        self.counts = {}
+
+    def start(self):
+        threading.Thread(target=self._poller, daemon=True).start()
+
+    def _poller(self):
+        self.counts.setdefault("a", 0)
+
+    @thread_root("framework-callback")
+    def on_event(self):
+        self.counts.pop("a", None)
+"""
+
+
+def test_unguarded_shared_state(tmp_path):
+    # two thread roots compound-mutate Svc.counts with no common lock
+    res = lint_src(tmp_path, UNGUARDED_SHARED)
+    assert rules_of(res) == ["thread-unguarded-shared-state"]
+    assert "2 thread roots" in res.findings[0].message
+    # common lock at every mutation site: clean
+    assert not lint_src(tmp_path, UNGUARDED_SHARED_LOCKED).findings
+    # @guarded_by declared: rules_lock owns enforcement, not inference
+    assert not lint_src(tmp_path, UNGUARDED_SHARED_DECLARED).findings
+    # @single_writer declared (per-shard ownership): exempt by design
+    assert not lint_src(tmp_path, UNGUARDED_SINGLE_WRITER).findings
+    # plain rebinds are the atomic-publish idiom, never compound
+    assert not lint_src(tmp_path, UNGUARDED_ATOMIC_REBIND).findings
+
+
+def test_thread_root_marker_is_a_root(tmp_path):
+    # an @thread_root-marked framework callback counts as a root even
+    # though no Thread(target=...) spawn is visible in the AST
+    res = lint_src(tmp_path, UNGUARDED_THREAD_ROOT_MARKER)
+    assert rules_of(res) == ["thread-unguarded-shared-state"]
+
+
+def test_concurrency_finding_pragma_suppression(tmp_path):
+    src = UNGUARDED_SHARED.replace(
+        '        self.counts.setdefault("a", 0)',
+        '        # graftlint: disable=thread-unguarded-shared-state '
+        '(benign test fixture)\n'
+        '        self.counts.setdefault("a", 0)')
+    res = lint_src(tmp_path, src)
+    assert not res.findings and res.suppressed == 1
+
+
+def test_concurrency_finding_github_annotation(tmp_path):
+    from filodb_tpu.lint.ci_annotations import github_annotations
+    res = lint_src(tmp_path, DEEP_BLOCKING)
+    lines = github_annotations(res.to_json())
+    assert len(lines) == 1
+    assert lines[0].startswith("::error file=")
+    assert "lock-blocking-reachable" in lines[0]
+
+
+def test_rules_catalog_has_concurrency_family():
+    from filodb_tpu.lint import rules
+    cat = rules()
+    for rid in ("lock-order-cycle", "lock-order-policy",
+                "lock-blocking-reachable",
+                "thread-unguarded-shared-state"):
+        assert rid in cat and cat[rid].family == "concurrency"
+        assert cat[rid].severity == "error"
+
+
+# -- --changed-only (git-diff-scoped reporting) ------------------------------
+
+def test_report_only_filters_findings(tmp_path):
+    bad1 = tmp_path / "one.py"
+    bad1.write_text(TRACE_SIDE_EFFECT)
+    bad2 = tmp_path / "two.py"
+    bad2.write_text(TRACE_SIDE_EFFECT)
+    full = run_lint([str(bad1), str(bad2)], baseline=frozenset(),
+                    check_contracts=False)
+    assert len(full.findings) == 2
+    only = run_lint([str(bad1), str(bad2)], baseline=frozenset(),
+                    check_contracts=False,
+                    report_only=frozenset([full.findings[0].path]))
+    assert len(only.findings) == 1
+    assert only.findings[0].path == full.findings[0].path
+
+
+def test_changed_only_cli_reports_nothing_when_tree_clean(tmp_path,
+                                                          monkeypatch):
+    # point package_root at a tmp git repo with one committed file and
+    # one dirty file; --changed-only must anchor findings to the dirty
+    # file only (the committed one still participates in the analysis)
+    import subprocess
+    import filodb_tpu.lint as lint_mod
+    import filodb_tpu.lint.__main__ as lint_main
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "seed"],
+                   cwd=repo, check=True)
+    committed = repo / "old.py"
+    committed.write_text(TRACE_SIDE_EFFECT)
+    subprocess.run(["git", "add", "old.py"], cwd=repo, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "-m", "add old"], cwd=repo,
+                   check=True)
+    dirty = repo / "new.py"
+    dirty.write_text(HOT_TRANSFER)
+    monkeypatch.setattr(lint_mod, "package_root", lambda: str(repo))
+    monkeypatch.setattr(lint_main, "package_root", lambda: str(repo))
+    changed = lint_main.changed_files()
+    assert changed == frozenset(["new.py"])
+    res = run_lint([str(repo)], baseline=frozenset(),
+                   check_contracts=False, report_only=changed)
+    assert [f.path for f in res.findings] == ["new.py"]
+    assert res.findings[0].rule == "host-transfer-in-hot-loop"

@@ -1,17 +1,35 @@
 """Tier-1 gate: the real package lints clean against the shipped
-baseline, every pallas_call site carries a verified contract, and the
-baseline itself is empty (nothing grandfathered)."""
+baseline — with the interprocedural concurrency families enabled at
+error severity — every pallas_call site carries a verified contract,
+the baseline itself is empty (nothing grandfathered), and a full run
+stays inside the pre-commit latency budget."""
 
 import json
+import time
 
 from filodb_tpu.lint import baseline_path, load_baseline, run_lint
 
 
-def test_package_lints_clean():
+def test_package_lints_clean_and_fast():
+    t0 = time.monotonic()
     res = run_lint()        # full package, contracts included
+    elapsed = time.monotonic() - t0
     assert res.files > 50
     msgs = [f.render() for f in res.findings]
     assert not msgs, "graftlint findings:\n" + "\n".join(msgs)
+    # perf guard: the whole-program analysis (call graph + lock
+    # propagation + contracts) must stay pre-commit-fast; ~4s on the
+    # dev rig, 30s is the hard ceiling before it stops being run
+    assert elapsed < 30.0, f"full lint run took {elapsed:.1f}s"
+
+
+def test_concurrency_families_enabled_at_error():
+    from filodb_tpu.lint import rules
+    cat = rules()
+    for rid in ("lock-order-cycle", "lock-order-policy",
+                "lock-blocking-reachable",
+                "thread-unguarded-shared-state"):
+        assert cat[rid].severity == "error"
 
 
 def test_shipped_baseline_is_empty():
