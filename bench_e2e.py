@@ -21,6 +21,16 @@ sliding window from 8 clients — the refresh pattern the results cache
 qps/p50 plus the hit ratio and cached-steps-served scraped from
 /metrics ("dashboard" in the output JSON).
 
+A third WORKER SWEEP drives the process-sharded serving tier
+(standalone/supervisor.py): for 1/2/4/N worker processes behind one
+SO_REUSEPORT public port, a fixed closed-loop client level measures
+e2e qps/p50 plus per-worker qps and batcher occupancy (scraped from
+each worker's private /metrics), and pins byte-identity of the data
+section against the 1-worker deployment ("worker_sweep" in the output
+JSON). The GIL plateau only breaks with real cores: on a 1-core rig
+the sweep documents the overhead floor, on a >=4-core host it is the
+>=3x acceptance measurement.
+
 Prints ONE JSON line.
 """
 
@@ -523,8 +533,237 @@ def measure():
             proc.kill()
 
 
+# -- worker sweep: the process-sharded serving tier ------------------------
+
+SWEEP_SAMPLES = 180         # 30min at 10s — enough for the 15-45m windows
+SWEEP_INSTANCES = 8
+SWEEP_SHARDS = 4
+SWEEP_CLIENTS = 16
+SWEEP_QUERIES = [
+    "rate(http_requests_total[5m])",
+    "sum(rate(http_requests_total[5m])) by (instance)",
+    "avg_over_time(heap_usage[10m])",
+    "max(heap_usage) by (instance)",
+]
+
+
+def _sweep_corpus(stream_dir):
+    """Test-owned WAL producer plane (the Kafka analogue): every worker
+    consumes its own shard-group's streams regardless of fleet size."""
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.producer import TestTimeseriesProducer
+    from filodb_tpu.ingest import LogIngestionStream
+    prod = TestTimeseriesProducer(DEFAULT_SCHEMAS,
+                                  num_shards=SWEEP_SHARDS)
+    streams = {}
+    for sh in range(SWEEP_SHARDS):
+        path = os.path.join(stream_dir, f"shard={sh}", "stream.log")
+        streams[sh] = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for builders in (prod.gauges(T0 * 1000, SWEEP_SAMPLES,
+                                 SWEEP_INSTANCES),
+                     prod.counters(T0 * 1000, SWEEP_SAMPLES,
+                                   SWEEP_INSTANCES)):
+        for sh, b in builders.items():
+            for c in b.containers():
+                streams[sh].append(c)
+    for s in streams.values():
+        s.close()
+
+
+def _spawn_supervisor(cfg):
+    cfg_dir = tempfile.mkdtemp(prefix="filodb-sweep-cfg-")
+    cfg_path = os.path.join(cfg_dir, "sup.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = os.environ.get("FILODB_E2E_PLATFORM", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.supervisor",
+         "--config", cfg_path],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    buf = b""
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline and b"\n" not in buf:
+        r, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if r:
+            ch = proc.stdout.read1(4096)
+            if not ch:
+                raise RuntimeError("supervisor died during startup")
+            buf += ch
+    return proc, json.loads(buf.split(b"\n", 1)[0])
+
+
+def _sweep_query(client, i, cache=True):
+    q = SWEEP_QUERIES[i % len(SWEEP_QUERIES)]
+    span = 900 + (i % 4) * 600
+    start = T0 + 600 + (i * 37) % 300
+    params = dict(query=q, start=start, end=start + span, step=60)
+    if not cache:
+        params["cache"] = "false"
+    t0 = time.perf_counter()
+    raw = client.get_raw("/promql/timeseries/api/v1/query_range",
+                         **params)
+    dt = time.perf_counter() - t0
+    assert raw.startswith(b'{"status":"success"'), raw[:120]
+    return dt, raw
+
+
+def _worker_counts(port):
+    """Per-worker counters scraped off a PRIVATE port."""
+    cl = KeepAliveClient(port)
+    out = {
+        "queries": _scrape_metric(cl, "query_latency_seconds_count"),
+        "batches": _scrape_metric(cl, "batcher_batches_total"),
+        "batched": _scrape_metric(cl, "batcher_queries_total"),
+    }
+    cl.close()
+    return out
+
+
+def measure_worker_sweep():
+    import shutil
+    cores = os.cpu_count() or 1
+    levels = sorted({1, 2, 4, cores} & set(range(1, max(cores, 4) + 1)))
+    out_levels = []
+    golden = None
+    for workers in levels:
+        tmp = tempfile.mkdtemp(prefix=f"filodb-sweep-w{workers}-")
+        _sweep_corpus(os.path.join(tmp, "streams"))
+        cfg = {
+            "num-shards": SWEEP_SHARDS, "port": _free_port(),
+            "serving-workers": workers,
+            "supervisor-port": 0,
+            "run-dir": os.path.join(tmp, "run"),
+            "data-dir": os.path.join(tmp, "data"),
+            "stream-dir": os.path.join(tmp, "streams"),
+            "flush-interval-s": 0.5,
+            "max-chunks-size": 100,
+            "query-sample-limit": 0, "query-series-limit": 0,
+            # the production data plane: sibling leaf dispatch rides
+            # protobuf+NibblePack over persistent channels (ports
+            # advertised via health gossip)
+            "grpc-port": 0,
+            # admission sized for the level so the GLOBAL quota is not
+            # the bottleneck under SWEEP_CLIENTS closed-loop clients
+            "max-inflight-queries": max(8, 2 * workers),
+        }
+        proc, line = _spawn_supervisor(cfg)
+        try:
+            pub = line["port"]
+            worker_ports = [w["port"] for w in line["workers"]]
+            want = 2 * SWEEP_INSTANCES
+
+            # replay + full results
+            probe = KeepAliveClient(pub)
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                try:
+                    _, raw = _sweep_query(probe, 0, cache=False)
+                    if raw.count(b'"metric"') >= SWEEP_INSTANCES:
+                        break
+                except (OSError, AssertionError):
+                    probe.close()
+                time.sleep(0.3)
+            time.sleep(2.0)         # settle: chunks + watermarks
+
+            # warm EVERY worker's compile/plan caches, entry and peer
+            # paths alike (per-process caches: each interpreter pays
+            # its own warmup)
+            for port in worker_ports:
+                wcl = KeepAliveClient(port)
+                for rep in range(2):
+                    for i in range(len(SWEEP_QUERIES)):
+                        _sweep_query(wcl, i + 4 * rep)
+                wcl.close()
+            for rep in range(2 * workers):
+                for i in range(len(SWEEP_QUERIES)):
+                    _sweep_query(probe, i + 4 * rep)
+
+            # byte-identity vs the 1-worker deployment (data section;
+            # the stats tail carries wall-clock timings)
+            _, raw = _sweep_query(probe, 0, cache=False)
+            data = raw.partition(b',"stats":')[0]
+            if golden is None:
+                golden = data
+            identical = data == golden
+            probe.close()
+
+            # fixed closed-loop level through the PUBLIC port
+            lats = []
+            lock = threading.Lock()
+            t_end = [0.0]
+
+            def client_loop(cid):
+                time.sleep(cid * 0.002)
+                cl = KeepAliveClient(pub)
+                i = 0
+                while time.perf_counter() < t_end[0]:
+                    dt, _ = _sweep_query(cl, cid * 100_000 + i)
+                    i += 1
+                    with lock:
+                        lats.append(dt)
+                cl.close()
+
+            before = {p: _worker_counts(p) for p in worker_ports}
+            t0 = time.perf_counter()
+            t_end[0] = t0 + 2.5
+            threads = [threading.Thread(target=client_loop, args=(c,))
+                       for c in range(SWEEP_CLIENTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            after = {p: _worker_counts(p) for p in worker_ports}
+            per_worker = {}
+            for idx, p in enumerate(worker_ports):
+                dq = after[p]["queries"] - before[p]["queries"]
+                db = after[p]["batches"] - before[p]["batches"]
+                dbq = after[p]["batched"] - before[p]["batched"]
+                per_worker[str(idx)] = {
+                    "qps": round(dq / wall, 1),
+                    "batcher_occupancy": round(dbq / db, 2)
+                    if db > 0 else 1.0,
+                }
+            lats_ms = np.asarray(lats) * 1000
+            out_levels.append({
+                "workers": workers,
+                "clients": SWEEP_CLIENTS,
+                "queries": len(lats),
+                "e2e_qps": round(len(lats) / wall, 1),
+                "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+                "p95_ms": round(float(np.percentile(lats_ms, 95)), 2),
+                "byte_identical": identical,
+                "per_worker": per_worker,
+            })
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            shutil.rmtree(tmp, ignore_errors=True)
+    base_qps = out_levels[0]["e2e_qps"] if out_levels else 0.0
+    best = max(out_levels, key=lambda l: l["e2e_qps"]) \
+        if out_levels else None
+    return {
+        "cores": cores,
+        "levels": out_levels,
+        "byte_identical": all(l["byte_identical"] for l in out_levels),
+        "best_workers": best["workers"] if best else 0,
+        "qps_speedup_vs_1worker": round(best["e2e_qps"] / base_qps, 2)
+        if best and base_qps else 0.0,
+    }
+
+
 def main():
-    print(json.dumps(measure()))
+    out = measure()
+    try:
+        out["worker_sweep"] = measure_worker_sweep()
+    except Exception as e:  # noqa: BLE001 — the sweep must not void
+        out["worker_sweep"] = {"error": repr(e)}    # the main bench
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -117,7 +117,7 @@ def matrix_bytes(grid: GridResult, stats_json: Dict,
     if rows_memo is not None:
         joined = rows_memo.get()
     if joined is None:
-        rows: List[str] = []
+        rows: List[tuple] = []
         steps_s = grid.steps / 1000.0
         memo = _FMT_MEMO
         if len(memo) > _FMT_MEMO_MAX:
@@ -129,7 +129,8 @@ def matrix_bytes(grid: GridResult, stats_json: Dict,
                 continue
             vals = row[ok]
             ts = steps_s[ok].tolist()
-            metric = json.dumps(_metric(key), separators=(",", ":"))
+            metric = json.dumps(_metric(key), sort_keys=True,
+                                separators=(",", ":"))
             if np.isinf(vals).any():
                 frags = [f'[{_ts_frag(t)},"{_fmt(v)}"]'
                          for t, v in zip(ts, vals.tolist())]
@@ -140,9 +141,14 @@ def matrix_bytes(grid: GridResult, stats_json: Dict,
                     if s is None:
                         memo[v] = s = repr(v)
                     frags.append(f'[{_ts_frag(t)},"{s}"]')
-            rows.append('{"metric":%s,"values":[%s]}'
-                        % (metric, ",".join(frags)))
-        joined = ",".join(rows)
+            rows.append((metric, '{"metric":%s,"values":[%s]}'
+                         % (metric, ",".join(frags))))
+        # deterministic series order (sorted by the encoded metric):
+        # responses are a pure function of the data, not of scan /
+        # ingest / peer-merge order — the property that makes
+        # single-worker and N-worker serving byte-identical
+        rows.sort(key=lambda kv: kv[0])
+        joined = ",".join(txt for _, txt in rows)
         if rows_memo is not None:
             rows_memo.put(joined)
     tail = ',"stats":' + json.dumps(stats_json, separators=(",", ":"))
@@ -183,6 +189,7 @@ def matrix(grid: GridResult, hist_wire: bool = False) -> Dict:
             }
         if entry is not None:
             result.append(entry)
+    result.sort(key=_entry_order)       # deterministic series order
     return success({"resultType": "matrix", "result": result})
 
 
@@ -195,6 +202,7 @@ def vector(grid: GridResult) -> Dict:
         if np.isnan(v):
             continue
         result.append({"metric": _metric(key), "value": [t, _fmt(v)]})
+    result.sort(key=_entry_order)       # deterministic series order
     return success({"resultType": "vector", "result": result})
 
 
@@ -226,11 +234,21 @@ def attach_degraded(out: Dict, res, stats=None) -> Dict:
     return out
 
 
+def _entry_order(entry: Dict) -> str:
+    """Sort key for result entries: the canonically-encoded metric.
+    Both encode paths (dict tree and pre-encoded bytes) order series by
+    it, so a response is a pure function of its data — single-worker
+    and N-worker topologies answer byte-identically even though their
+    scan/peer-merge orders differ."""
+    return json.dumps(entry["metric"], sort_keys=True,
+                      separators=(",", ":"))
+
+
 def _metric(key: Dict[str, str]) -> Dict[str, str]:
-    out = {}
-    for k, v in key.items():
-        if k == "_metric_":
-            out["__name__"] = v
-        else:
-            out[k] = v
-    return out
+    # sorted OUTPUT label order: the JSON text of a metric (and
+    # therefore the _entry_order sort key and the matrix_bytes
+    # fragments) is stable regardless of the label-map construction
+    # order upstream, and insertion-order json.dumps matches
+    # sort_keys=True exactly
+    return dict(sorted(("__name__" if k == "_metric_" else k, v)
+                       for k, v in key.items()))

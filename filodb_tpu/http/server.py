@@ -89,7 +89,9 @@ class FiloHttpServer:
                  max_inflight_queries: int = 4,
                  tracer: Optional[Tracer] = None,
                  slow_query_ms: float = 1000.0,
-                 slow_query_capacity: int = 128):
+                 slow_query_capacity: int = 128,
+                 peer_fanout_workers: int = 0,
+                 worker_id: Optional[int] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -262,6 +264,24 @@ class FiloHttpServer:
         self.httpd = _Server((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
+        # metadata/cardinality peer fan-out concurrency: was a
+        # hard-coded min(8, len(targets)) — size it from the knob
+        # (0 = auto from the host's core count) and surface it in
+        # /metrics so operators can see what a node actually uses
+        if peer_fanout_workers and int(peer_fanout_workers) > 0:
+            self.fanout_workers = int(peer_fanout_workers)
+        else:
+            import os
+            self.fanout_workers = min(32, max(2, os.cpu_count() or 2))
+        # process-sharded serving: this worker's ordinal in a
+        # supervisor deployment (None = standalone single process).
+        # Rides /metrics so the supervisor's aggregate view can tell
+        # workers apart even before it injects its own worker label.
+        self.worker_id = worker_id
+        # extra accept edges (process-sharded serving): SO_REUSEPORT /
+        # inherited-fd listener sockets whose accept loops feed the
+        # same ThreadingHTTPServer machinery as the private port
+        self._extra_listeners: list = []
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -269,7 +289,44 @@ class FiloHttpServer:
                                         daemon=True)
         self._thread.start()
 
+    def add_listener(self, sock) -> None:
+        """Attach an extra listening socket (the shared public accept
+        edge in a multi-worker deployment: an SO_REUSEPORT-bound socket,
+        or one inherited from the supervisor where SO_REUSEPORT is
+        unavailable). Accepted connections are handled by the same
+        per-connection handler threads as the private port — one HTTP
+        surface, two accept edges."""
+        import socket as _socket
+
+        @thread_root("accept-edge")
+        def _accept_loop():
+            while True:
+                try:
+                    conn, addr = sock.accept()
+                except OSError:
+                    return          # socket closed on stop()
+                try:
+                    # ThreadingMixIn spawns the handler thread; the
+                    # handler applies keep-alive/NODELAY itself
+                    self.httpd.process_request(conn, addr)
+                except Exception:   # noqa: BLE001 — edge must not die
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+        t = threading.Thread(target=_accept_loop, daemon=True,
+                             name=f"accept-edge-{len(self._extra_listeners)}")
+        self._extra_listeners.append((sock, t))
+        if isinstance(sock, _socket.socket):
+            sock.settimeout(None)
+        t.start()
+
     def stop(self) -> None:
+        for sock, _t in self._extra_listeners:
+            try:
+                sock.close()
+            except OSError:
+                pass
         self.httpd.shutdown()
         self.httpd.server_close()
 
@@ -1142,6 +1199,23 @@ class FiloHttpServer:
         "filodb_detector_thread_wedged":
             "1 if the failure-detector monitor thread failed to exit "
             "on stop()",
+        "filodb_peer_fanout_workers":
+            "Metadata/cardinality peer fan-out concurrency "
+            "(peer-fanout-workers knob; auto = host core count)",
+        "filodb_worker_ordinal":
+            "This process's worker ordinal in a supervisor deployment",
+        "filodb_bus_events_published_total":
+            "Control-plane events this worker published to the "
+            "supervisor bus",
+        "filodb_bus_events_applied_total":
+            "Control-plane events this worker applied from the "
+            "supervisor bus (topology/schema invalidations, "
+            "watermark gossip, worker lifecycle hints)",
+        "filodb_bus_reconnects_total":
+            "Reconnects of this worker's bus client to the supervisor",
+        "filodb_bus_connected":
+            "1 while the worker's bus client is connected to the "
+            "supervisor's control plane",
         "filodb_traces_started_total": "Traces started on this node",
         "filodb_traces_stored": "Finished traces in /debug/traces",
         "filodb_slow_queries_total": "Queries over the slow-query "
@@ -1289,6 +1363,16 @@ class FiloHttpServer:
              self.stale_routing_bounces)
         emit("stale_routing_retries_total", {},
              self.stale_routing_retries)
+        emit("peer_fanout_workers", {}, self.fanout_workers)
+        if self.worker_id is not None:
+            emit("worker_ordinal", {}, int(self.worker_id))
+        bus = getattr(self, "bus_client", None)
+        if bus is not None:
+            bs = bus.metrics_snapshot()
+            emit("bus_events_published_total", {}, bs["published"])
+            emit("bus_events_applied_total", {}, bs["applied"])
+            emit("bus_reconnects_total", {}, bs["reconnects"])
+            emit("bus_connected", {}, bs["connected"])
         if self.detector is not None:
             emit("detector_thread_wedged", {},
                  1 if getattr(self.detector, "thread_wedged", False)
@@ -1496,10 +1580,13 @@ class FiloHttpServer:
                            + "?" + urllib.parse.urlencode(qs, doseq=True))
         return targets
 
-    @staticmethod
-    def _fanout(targets: List[str]) -> List[Dict]:
+    def _fanout(self, targets: List[str]) -> List[Dict]:
         """Concurrent GETs; returns successful payloads only (down peers
-        yield partial results, matching the query path's semantics)."""
+        yield partial results, matching the query path's semantics).
+        Concurrency is ``fanout_workers`` (knob ``peer-fanout-workers``,
+        auto-sized from the core count; surfaced in /metrics) — the old
+        hard-coded cap of 8 serialized metadata fan-out on wide
+        clusters."""
         import urllib.request as ureq
         from concurrent.futures import ThreadPoolExecutor
         if not targets:
@@ -1515,7 +1602,9 @@ class FiloHttpServer:
                 pass
             return None
 
-        with ThreadPoolExecutor(max_workers=min(8, len(targets))) as ex:
+        with ThreadPoolExecutor(
+                max_workers=min(self.fanout_workers,
+                                len(targets))) as ex:
             return [p for p in ex.map(fetch, targets) if p]
 
     def _peer_metadata_union(self, ds: str, rest: str, qs: Dict) -> set:

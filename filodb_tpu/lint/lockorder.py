@@ -28,6 +28,11 @@ from typing import Dict, Optional, Tuple
 
 # outermost (acquired first) .. innermost (acquired last, leaf)
 CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
+    # host-level coordination: the worker supervisor's fleet state
+    # (its methods never call into a worker's in-process locks — the
+    # supervisor talks to workers over HTTP/bus only — but keep it
+    # outermost so that invariant is policy, not accident)
+    "Supervisor._lock",
     # node-level coordination: membership/handoff + crash reassignment
     "MembershipManager._lock",
     "FiloServer._reassign_lock",
@@ -50,6 +55,11 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
     "LogIngestionStream._lock",
     "MemoryIngestionStream._lock",
     "filodb_tpu.grpcsvc.client:_channels_lock",
+    # control-plane bus (standalone/bus.py): registry locks release
+    # before any socket send; per-connection send locks are pure leaves
+    "SupervisorBus._lock",
+    "BusClient._lock",
+    "BusClient._send_lock",
 )
 
 _INDEX: Dict[str, int] = {name: i

@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import bisect
 import math
+import re
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.locks import guarded_by, single_writer
 
 # latency buckets in seconds: sub-ms serving path up to multi-second
 # degraded tails (the Prometheus http duration defaults, extended down)
@@ -179,6 +180,9 @@ def escape_help(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
+@single_writer("an ExpositionBuilder is constructed, filled, and "
+               "rendered by ONE request/scrape thread; instances are "
+               "never shared (each /metrics render builds its own)")
 class ExpositionBuilder:
     """Family-grouped Prometheus text-format writer.
 
@@ -251,3 +255,83 @@ class ExpositionBuilder:
                 else:
                     lines.append(f"{name} {value}")
         return "\n".join(lines) + "\n"
+
+
+# -- multi-worker aggregation ------------------------------------------------
+
+_LABELS_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"') \
+        .replace("\\\\", "\\")
+
+
+def parse_exposition(text: str,
+                     help_sink: Optional[Dict[str, str]] = None
+                     ) -> "List[Tuple[str, str, str, Dict[str, str], str]]":
+    """Parse Prometheus text format into
+    ``(family, mtype, sample_name, labels, value)`` rows (family = the
+    HELP/TYPE grouping name, so ``x_bucket`` rows carry family ``x``).
+    ``help_sink`` (optional) collects each family's HELP text.
+    Tolerant of unknown lines (skipped), so a worker running newer code
+    than its supervisor still aggregates."""
+    out = []
+    mtypes: Dict[str, str] = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            if help_sink is not None:
+                parts = ln.split(" ", 3)
+                if len(parts) == 4:
+                    help_sink.setdefault(parts[2], parts[3])
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) >= 4:
+                mtypes[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#"):
+            continue
+        name_part, _, value = ln.rpartition(" ")
+        if not name_part:
+            continue
+        if "{" in name_part:
+            name, _, rest = name_part.partition("{")
+            labels = {k: _unescape_label(v)
+                      for k, v in _LABELS_RE.findall(
+                          rest.rsplit("}", 1)[0])}
+        else:
+            name, labels = name_part, {}
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and mtypes.get(base) == "histogram":
+                fam = base
+                break
+        out.append((fam, mtypes.get(fam, ""), name, labels, value))
+    return out
+
+
+def merge_expositions(by_worker: "Dict[str, str]",
+                      help_table: Optional[Dict[str, str]] = None) -> str:
+    """The supervisor's ``/metrics`` aggregation: each worker's
+    exposition re-emitted with a ``worker`` label injected into every
+    sample, one HELP/TYPE block per family across all workers. Workers
+    stay individually scrapeable on their private ports; this is the
+    one-target view (per-worker batcher occupancy, qps, cache hit
+    ratios side by side)."""
+    b = ExpositionBuilder()
+    helps: Dict[str, str] = dict(help_table or {})
+    parsed = {w: parse_exposition(by_worker[w], help_sink=helps)
+              for w in by_worker}
+    for worker in sorted(parsed, key=str):
+        for fam, mtype, name, labels, value in parsed[worker]:
+            if not mtype:
+                mtype = "counter" if fam.endswith("_total") else "gauge"
+            b.sample(name, {**labels, "worker": str(worker)}, value,
+                     mtype=mtype,
+                     help=helps.get(fam, f"FiloDB metric {fam}"),
+                     family=fam)
+    return b.render()

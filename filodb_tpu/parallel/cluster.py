@@ -448,6 +448,32 @@ class FailureDetector:
         except OSError:
             return None
 
+    def note_peer_exit(self, node: str) -> None:
+        """Supervisor-bus hint: a sibling worker PROCESS exited (the
+        supervisor waitpid'd it — ground truth, no probe needed). Drop
+        the peer's data-plane channel and its gossiped watermarks
+        immediately instead of waiting out poll misses: a dead worker's
+        stale watermark advertisement must not keep bounding the
+        results cache's freshness horizon while it restarts. Routing is
+        deliberately NOT flipped DOWN here — the supervisor is already
+        respawning the worker at the same address, so in-flight peer
+        calls ride their retry budget through the restart window."""
+        if node not in self.peers:
+            return
+        if self.grpc_peer_sink is not None:
+            old = self.grpc_peer_sink.pop(node, None)
+            if old is not None:
+                _drop_grpc_channel(old)
+        if self.peer_state_sink is not None:
+            self.peer_state_sink.pop(node, None)
+
+    def note_peer_up(self, node: str) -> None:
+        """Supervisor-bus hint: a sibling worker finished restarting.
+        Reset the miss counter so one stale in-flight probe can't push
+        the fresh process over the down threshold."""
+        if node in self._misses:
+            self._misses[node] = 0
+
     def is_down(self, node: str) -> bool:
         return self._down.get(node, False)
 

@@ -1,6 +1,21 @@
 """Standalone server (reference: standalone/FiloServer.scala:112,
-NewFiloServerMain.scala:21)."""
+NewFiloServerMain.scala:21) and the process-sharded serving supervisor.
 
-from filodb_tpu.standalone.server import FiloServer
+Imports are lazy (PEP 562): the supervisor process deliberately never
+imports the query/engine stack (numpy/jax) — it only forks, monitors,
+and aggregates workers — so pulling :class:`Supervisor` must not drag
+:class:`FiloServer`'s dependency tree in.
+"""
 
-__all__ = ["FiloServer"]
+
+def __getattr__(name):
+    if name == "FiloServer":
+        from filodb_tpu.standalone.server import FiloServer
+        return FiloServer
+    if name == "Supervisor":
+        from filodb_tpu.standalone.supervisor import Supervisor
+        return Supervisor
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["FiloServer", "Supervisor"]

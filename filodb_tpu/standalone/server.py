@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 from typing import Dict, Optional
 
@@ -186,7 +187,54 @@ DEFAULTS = {
     # ACTIVE advertisement must fit, or the shard rolls back to the
     # draining owner
     "handoff-timeout-s": 30.0,
+    # metadata/cardinality peer fan-out concurrency (was hard-coded 8);
+    # 0 = auto-size from the host core count. Surfaced in /metrics as
+    # filodb_peer_fanout_workers.
+    "peer-fanout-workers": 0,
+    # -- process-sharded serving tier (standalone/supervisor.py) ------
+    # These keys are normally derived by the supervisor, which forks N
+    # worker processes per host — each an ordinal-owned shard-group
+    # node with PRIVATE plan/executable/results caches, batcher, and
+    # device executor — behind ONE public port.
+    #   worker-id:    this process's worker ordinal (None = standalone)
+    #   accept-port:  shared public port; bound here with SO_REUSEPORT
+    #                 so the kernel balances accepted connections
+    #                 across workers
+    #   accept-fd:    inherited listening-socket fd (the fd-passing
+    #                 fallback where SO_REUSEPORT is unavailable; the
+    #                 supervisor binds once and every worker accepts
+    #                 on the shared socket)
+    #   bus-port:     the supervisor's local control plane
+    #                 (standalone/bus.py): topology / schema /
+    #                 watermark events fan out to every sibling so
+    #                 per-process caches stay coherent with membership
+    "worker-id": None,
+    "accept-port": None,
+    "accept-host": "127.0.0.1",
+    "accept-fd": None,
+    "bus-port": None,
+    # cadence of this worker's watermark/backfill gossip on the bus
+    # (the detector's health-body gossip remains the backstop)
+    "bus-watermark-interval-s": 0.25,
 }
+
+
+def bind_reuseport(host: str, port: int):
+    """A listening socket on (host, port) with SO_REUSEPORT, or None
+    when the platform doesn't support it (the supervisor then falls
+    back to binding once and passing the fd to every worker)."""
+    import socket as _socket
+    if not hasattr(_socket, "SO_REUSEPORT"):
+        return None
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+        s.bind((host, int(port)))
+        s.listen(128)
+    except OSError:
+        s.close()
+        raise
+    return s
 
 
 class FiloServer:
@@ -220,9 +268,14 @@ class FiloServer:
         # elastic-recovery bookkeeping: origin node -> shards THIS node
         # adopted (crash or planned); node -> original assignment
         self._adopted: Dict[str, list] = {}
-        self._reassign_lock = __import__("threading").Lock()
+        self._reassign_lock = threading.Lock()
         self._original_shards: Dict[str, list] = {}
         self._gw_streams: Dict[int, object] = {}
+        # process-sharded serving: the worker's control-plane client
+        # (standalone/bus.py) + the watermark-gossip tick that rides it
+        self.bus_client = None
+        self._bus_tick_stop = threading.Event()
+        self._bus_tick_thread: Optional[threading.Thread] = None
 
     def _make_tracer(self):
         from filodb_tpu.obs.trace import Tracer
@@ -448,7 +501,35 @@ class FiloServer:
                 "max-inflight-queries", 4)),
             tracer=self._make_tracer(),
             slow_query_ms=float(self.config.get("slow-query-ms",
-                                                1000.0)))
+                                                1000.0)),
+            peer_fanout_workers=int(self.config.get(
+                "peer-fanout-workers", 0) or 0),
+            worker_id=self.config.get("worker-id"))
+        # process-sharded serving: the shared public accept edge —
+        # either this worker binds the public port itself with
+        # SO_REUSEPORT (kernel balances connections across workers) or
+        # it accepts on a listening socket inherited from the
+        # supervisor (fd-passing fallback). Both feed the same handler
+        # machinery as the private per-worker port.
+        self.accept_port = None
+        accept_sock = None
+        if self.config.get("accept-fd") is not None:
+            import socket as _socket
+            accept_sock = _socket.socket(
+                fileno=int(self.config["accept-fd"]))
+            self.accept_port = accept_sock.getsockname()[1]
+        elif self.config.get("accept-port"):
+            accept_sock = bind_reuseport(
+                str(self.config.get("accept-host", "127.0.0.1")),
+                int(self.config["accept-port"]))
+            if accept_sock is None:
+                raise RuntimeError(
+                    "accept-port configured but SO_REUSEPORT is "
+                    "unavailable on this platform — run the supervisor "
+                    "with fd passing (it detects this automatically)")
+            self.accept_port = accept_sock.getsockname()[1]
+        if accept_sock is not None:
+            self.http.add_listener(accept_sock)
         # elastic membership: wire the planned-handoff coordinator
         # BEFORE the HTTP edge starts serving, so an adopt/hand-back
         # request arriving the instant the health endpoint answers
@@ -485,6 +566,34 @@ class FiloServer:
             # the health body advertises this node's down-view (quorum
             # input) and served-shard statuses (gossip) to its peers
             self.http.detector = self.detector
+        # process-sharded serving: connect the control plane. Local
+        # mapper transitions are published to siblings the instant they
+        # commit (per-process plan/results caches must not serve
+        # extents keyed on a stale topology for a detector-poll
+        # interval), sibling transitions are applied to the local
+        # mapper (whose subscribers invalidate the caches), schema
+        # invalidations broadcast host-wide, and watermark/backfill
+        # gossip ticks faster than the health-body path.
+        if self.config.get("bus-port"):
+            from filodb_tpu.standalone.bus import BusClient
+            bc = BusClient(int(self.config["bus-port"]),
+                           int(self.config.get("worker-id") or 0),
+                           self.node_id)
+            bc.on("topology", self._bus_apply_topology)
+            bc.on("schema", self._bus_apply_schema)
+            bc.on("watermarks", self._bus_apply_watermarks)
+            bc.on("worker-exit", self._bus_apply_worker_exit)
+            bc.on("worker-up", self._bus_apply_worker_up)
+            self.bus_client = bc.start()
+            self.http.bus_client = bc
+            self.mapper.subscribe(self._bus_publish_topology)
+            tick_s = float(self.config.get(
+                "bus-watermark-interval-s", 0.25))
+            if tick_s > 0:
+                self._bus_tick_thread = threading.Thread(
+                    target=self._bus_watermark_run, args=(tick_s,),
+                    daemon=True, name="bus-watermark-tick")
+                self._bus_tick_thread.start()
         self.tenant_metering = None
         meter_s = float(self.config.get("tenant-metering-interval-s", 0))
         if meter_s > 0 and self.card_trackers:
@@ -505,6 +614,100 @@ class FiloServer:
             t0, t1, t2 = gc.get_threshold()
             gc.set_threshold(t0, t1, max(t2, 100))
         return self
+
+    # -- control plane (standalone/bus.py) --------------------------------
+    def _bus_publish_topology(self, ev) -> None:
+        """ShardMapper subscriber: ship every locally-witnessed FSM
+        transition to the siblings. BusClient.publish() is a no-op on
+        the bus reader thread (the apply→republish loop breaker) and on
+        transport failure (detector gossip re-converges)."""
+        bc = self.bus_client
+        if bc is None:
+            return
+        bc.publish({"type": "topology", "shard": int(ev.shard),
+                    "status": ev.status.value, "node": ev.node,
+                    "epoch": self.mapper.topology_epoch})
+
+    def _bus_apply_topology(self, ev: Dict) -> None:
+        """A sibling witnessed a shard FSM transition: converge the
+        local mapper (idempotent — the mapper bumps its epoch only when
+        the ownership edge actually rewires), which fires this worker's
+        own subscribers and therefore the plan/results-cache
+        invalidation. Already-converged events are dropped without
+        touching the caches."""
+        from filodb_tpu.parallel.shardmapper import ShardStatus
+        try:
+            shard = int(ev.get("shard", -1))
+            st = ShardStatus(str(ev.get("status")))
+        except (TypeError, ValueError):
+            return
+        if not (0 <= shard < self.mapper.num_shards):
+            return
+        node = ev.get("node")
+        if self.mapper.status(shard) is st \
+                and self.mapper.node_of(shard) == node:
+            return
+        self.mapper.update(shard, st, node)
+
+    def _bus_apply_schema(self, ev: Dict) -> None:
+        if self.http is not None:
+            self.http.invalidate_plan_cache(
+                str(ev.get("reason") or "schema-bus"))
+
+    def _bus_apply_watermarks(self, ev: Dict) -> None:
+        """Sibling watermark/backfill gossip → the same per-peer sink
+        the failure detector fills from health bodies, so the results
+        cache's freshness horizon tracks sibling ingest at bus latency
+        instead of poll latency."""
+        origin = str(ev.get("origin") or "")
+        if not origin or origin == self.node_id or self.http is None:
+            return
+        def _ints(raw):
+            try:
+                return {int(k): int(v) for k, v in (raw or {}).items()}
+            except (TypeError, ValueError):
+                return {}
+        self.http.peer_watermarks[origin] = {
+            "watermarks": _ints(ev.get("watermarks")),
+            "epochs": _ints(ev.get("backfill_epochs")),
+            "topo_epoch": int(ev.get("topo_epoch") or 0),
+        }
+
+    def _bus_apply_worker_exit(self, ev: Dict) -> None:
+        node = str(ev.get("node") or "")
+        if self.detector is not None and node:
+            self.detector.note_peer_exit(node)
+
+    def _bus_apply_worker_up(self, ev: Dict) -> None:
+        node = str(ev.get("node") or "")
+        if self.detector is not None and node:
+            self.detector.note_peer_up(node)
+
+    def _bus_gossip_once(self) -> None:
+        """One watermark/backfill gossip beat onto the bus (the same
+        per-shard fields the health body advertises)."""
+        watermarks: Dict[str, int] = {}
+        epochs: Dict[str, int] = {}
+        for lst in self.http.shards_by_dataset.values():
+            for i, s in enumerate(lst):
+                n = getattr(s, "shard_num", i)
+                wm = getattr(s, "ingest_watermark_ms", None)
+                if wm is not None:
+                    watermarks[str(n)] = int(wm)
+                epochs[str(n)] = int(getattr(
+                    s, "ingest_backfill_epoch", 0) or 0)
+        self.bus_client.publish({
+            "type": "watermarks", "watermarks": watermarks,
+            "backfill_epochs": epochs,
+            "topo_epoch": self.mapper.topology_epoch})
+
+    @thread_root("bus-watermark-tick")
+    def _bus_watermark_run(self, interval_s: float) -> None:
+        while not self._bus_tick_stop.wait(interval_s):
+            try:
+                self._bus_gossip_once()
+            except Exception:   # noqa: BLE001 — gossip must not die
+                pass
 
     def _start_ingestion(self) -> None:
         """Streaming path: per-shard durable stream logs + ingestion
@@ -787,6 +990,11 @@ class FiloServer:
         return rows
 
     def stop(self) -> None:
+        self._bus_tick_stop.set()
+        if self._bus_tick_thread is not None:
+            self._bus_tick_thread.join(timeout=5)
+        if self.bus_client is not None:
+            self.bus_client.stop()
         if getattr(self, "grpc_server", None) is not None:
             self.grpc_server.stop()
         if getattr(self, "tenant_metering", None) is not None:
@@ -855,8 +1063,11 @@ def main(argv=None) -> int:
     gw = server.gateway.port if server.gateway is not None else None
     gp = server.grpc_server.port if getattr(server, "grpc_server", None) \
         is not None else None
-    print(json.dumps({"port": server.port, "gateway_port": gw,
-                      "grpc_port": gp}), flush=True)
+    line = {"port": server.port, "gateway_port": gw, "grpc_port": gp}
+    if getattr(server, "accept_port", None) is not None:
+        line["accept_port"] = server.accept_port
+        line["worker_id"] = server.config.get("worker-id")
+    print(json.dumps(line), flush=True)
     print(f"filodb-tpu server listening on :{server.port}", file=sys.stderr)
     try:
         while True:

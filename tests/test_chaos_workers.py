@@ -1,0 +1,327 @@
+"""Chaos scenario for the process-sharded serving tier: kill -9 one
+query worker mid-load — ZERO failed queries.
+
+The supervisor is the first responder: it waitpid's the corpse within a
+monitor tick, broadcasts ``worker-exit`` on the bus (siblings
+immediately drop the dead worker's gossiped watermarks and data-plane
+channel), and respawns the worker with the identical config — same
+ordinal, same ports. Sibling routing therefore does NOT rewire: peer
+calls targeting the dead worker's shards ride their retry budget
+through the restart window (the chaos config widens retries and holds
+the breaker closed, the documented operator recipe for supervised
+single-host fleets where "peer down" means "restarting right here").
+
+The load client speaks through the shared PUBLIC port like a real LB
+client: a connection severed by the kill is reconnected and the
+request reissued (query_range is idempotent); an HTTP error or a
+partial/deviating response counts as a FAILED query. After recovery
+the responses must be byte-identical to the pre-kill golden."""
+
+import json
+import os
+import pathlib
+import select
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+from filodb_tpu.lint.threads import thread_root
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+T0 = 1_600_000_000
+N_SAMPLES = 50
+N_INSTANCES = 4
+NUM_SHARDS = 4
+
+_QUERY = dict(query='rate({_metric_=~"heap_usage|http_requests_total"}'
+                    '[5m])',
+              start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60,
+              timeout="90s")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _write_corpus(stream_dir):
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.producer import TestTimeseriesProducer
+    from filodb_tpu.ingest import LogIngestionStream
+    prod = TestTimeseriesProducer(DEFAULT_SCHEMAS,
+                                  num_shards=NUM_SHARDS)
+    for sh in range(NUM_SHARDS):
+        path = os.path.join(stream_dir, f"shard={sh}", "stream.log")
+        stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+        for builders in (prod.gauges(T0 * 1000, N_SAMPLES,
+                                     N_INSTANCES),
+                         prod.counters(T0 * 1000, N_SAMPLES,
+                                       N_INSTANCES)):
+            for s, b in builders.items():
+                if s == sh:
+                    for c in b.containers():
+                        stream.append(c)
+        stream.close()
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return r.status, r.read()
+
+
+def _poll(fn, timeout=180.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+            if ok:
+                return last
+        except (OSError, ValueError):
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"poll timed out; last={last!r}")
+
+
+def _data_bytes(raw: bytes) -> bytes:
+    body, sep, _ = raw.partition(b',"stats":')
+    assert sep, raw[:200]
+    return body
+
+
+class _LbClient:
+    """A load-balancer-faithful client on the shared public port: one
+    keep-alive connection; a connection severed mid-exchange (the
+    victim worker died under it) reconnects — the kernel's reuseport
+    balancing lands the fresh connection on a live worker — and
+    reissues the idempotent GET. Only an HTTP-level error is a query
+    failure."""
+
+    def __init__(self, port):
+        self.port = port
+        self.sock = None
+        self.buf = b""
+
+    def _connect(self):
+        self.sock = socket.create_connection(("127.0.0.1", self.port),
+                                             timeout=120)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    def get(self, path, **params):
+        qs = urllib.parse.urlencode(params, doseq=True)
+        req = (f"GET {path}?{qs} HTTP/1.1\r\nHost: x\r\n\r\n").encode()
+        last_exc = None
+        for _attempt in range(40):      # transport retries, not query
+            try:
+                if self.sock is None:
+                    self._connect()
+                self.sock.sendall(req)
+                return self._read_response()
+            except OSError as e:
+                last_exc = e
+                self.close()
+                time.sleep(0.1)
+        raise last_exc
+
+    def _read_response(self):
+        while b"\r\n\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise OSError("closed mid-headers")
+            self.buf += chunk
+        head, self.buf = self.buf.split(b"\r\n\r\n", 1)
+        clen = 0
+        for ln in head.split(b"\r\n")[1:]:
+            k, _, v = ln.partition(b":")
+            if k.lower() == b"content-length":
+                clen = int(v.strip())
+                break
+        while len(self.buf) < clen:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise OSError("closed mid-body")
+            self.buf += chunk
+        body, self.buf = self.buf[:clen], self.buf[clen:]
+        status = int(head.split(b" ", 2)[1])
+        return status, body
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+            self.buf = b""
+
+
+class _QueryLoad(threading.Thread):
+    def __init__(self, port, golden):
+        super().__init__(daemon=True)
+        self.client = _LbClient(port)
+        self.golden = golden
+        self.failures = []
+        self.mismatches = []
+        self.ok = 0
+        self._halt = threading.Event()
+
+    @thread_root("chaos-worker-load")
+    def run(self):
+        while not self._halt.is_set():
+            try:
+                status, body = self.client.get(
+                    "/promql/timeseries/api/v1/query_range", **_QUERY)
+            except OSError as e:
+                self.failures.append(f"transport-exhausted: {e}")
+                continue
+            if status != 200:
+                self.failures.append((status, body[:200]))
+                continue
+            parsed = json.loads(body)
+            if parsed.get("status") != "success" \
+                    or parsed.get("partial"):
+                self.failures.append(
+                    (parsed.get("errorType"),
+                     parsed.get("error") or parsed.get("warnings")))
+                continue
+            if _data_bytes(body) != self.golden:
+                self.mismatches.append(len(body))
+                continue
+            self.ok += 1
+            self._halt.wait(0.05)
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=120)
+        self.client.close()
+
+
+def test_kill9_worker_mid_load_zero_failed_queries(tmp_path):
+    _write_corpus(str(tmp_path / "streams"))
+    cfg = {
+        "num-shards": NUM_SHARDS, "port": _free_port(),
+        "serving-workers": 2,
+        "supervisor-port": 0,
+        "run-dir": str(tmp_path / "run"),
+        "data-dir": str(tmp_path / "data"),
+        "stream-dir": str(tmp_path / "streams"),
+        "flush-interval-s": 0.4,
+        "max-chunks-size": 25,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "grpc-port": None,
+        "monitor-interval-s": 0.1,
+        "restart-backoff-s": 0.2,
+        # the supervised-fleet overload recipe: a dead sibling is
+        # "restarting right here", so peer calls out-wait the restart
+        # window instead of failing fast — wide retry budget, breaker
+        # held closed, detector never flips shards DOWN (a DOWN flip
+        # would surface partial results, which this scenario forbids)
+        "query-timeout-s": 120.0,
+        "peer-retry-attempts": 25,
+        "peer-retry-base-delay-s": 0.4,
+        "breaker-failure-threshold": 1_000_000,
+        "failure-detect-interval-s": 0.25,
+        "failure-detect-threshold": 1_000_000,
+        "max-inflight-queries": 8,
+    }
+    cfg_path = tmp_path / "sup.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.supervisor",
+         "--config", str(cfg_path)],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    load = None
+    try:
+        buf = b""
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and b"\n" not in buf:
+            r, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if r:
+                ch = proc.stdout.read1(4096)
+                if not ch:
+                    raise RuntimeError("supervisor died during startup")
+                buf += ch
+        line = json.loads(buf.split(b"\n", 1)[0])
+        pub, sup_port = line["port"], line["supervisor_port"]
+        want = 2 * N_INSTANCES
+
+        def _full():
+            _, body = _get(pub, "/promql/timeseries/api/v1/query_range",
+                           **{**_QUERY, "cache": "false"})
+            parsed = json.loads(body)
+            ok = (parsed.get("status") == "success"
+                  and "partial" not in parsed
+                  and len(parsed["data"]["result"]) >= want)
+            return ok, len(parsed.get("data", {}).get("result", ()))
+        _poll(_full)
+        time.sleep(3.0)         # settle: corpus fully chunk-resident
+        _, raw = _get(pub, "/promql/timeseries/api/v1/query_range",
+                      **_QUERY)
+        golden = _data_bytes(raw)
+
+        load = _QueryLoad(pub, golden)
+        load.start()
+        time.sleep(1.5)
+        assert load.ok > 0, (load.failures[:3], load.mismatches[:3])
+
+        # -- kill -9 worker 1 mid-load ---------------------------------
+        _, hb = _get(sup_port, "/__health")
+        health = json.loads(hb)
+        victim_pid = health["workers"]["1"]["pid"]
+        restarts0 = health["workers"]["1"]["restarts"]
+        os.kill(victim_pid, signal.SIGKILL)
+
+        # the supervisor reaps + respawns; the worker comes back READY
+        def _respawned():
+            _, hb2 = _get(sup_port, "/__health")
+            w = json.loads(hb2)["workers"]["1"]
+            return (w["restarts"] > restarts0 and w["alive"]
+                    and w["ready"] and w["pid"] != victim_pid), w
+        _poll(_respawned, timeout=120)
+
+        # keep the load running through the recovery tail, then assert
+        # the zero-failure invariant
+        time.sleep(3.0)
+
+        def _replayed():
+            _, body = _get(pub, "/promql/timeseries/api/v1/query_range",
+                           **{**_QUERY, "cache": "false"})
+            return _data_bytes(body) == golden, len(body)
+        _poll(_replayed, timeout=120)
+        time.sleep(1.0)
+        load.stop()
+
+        assert load.failures == [], load.failures[:5]
+        assert load.mismatches == [], load.mismatches[:5]
+        assert load.ok > 10, load.ok
+
+        # supervisor metrics recorded exactly one restart
+        _, mtext = _get(sup_port, "/metrics")
+        lines = mtext.decode().splitlines()
+        assert ('filodb_supervisor_worker_restarts_total{worker="1"} 1'
+                in lines), [ln for ln in lines if "restarts" in ln]
+    finally:
+        if load is not None and load.is_alive():
+            load._halt.set()
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -1,0 +1,298 @@
+"""Process-sharded serving tier e2e: a supervisor-run fleet must be
+indistinguishable from the single-process edge it replaces.
+
+One WAL corpus (test-owned producer, the Kafka analogue) is served
+first by a 1-worker deployment, then by a 2-worker deployment over the
+same durable dirs. Byte-identity is asserted for every entry point
+(public SO_REUSEPORT port, each worker's private port) with the results
+cache off and on — the deterministic response ordering makes the
+response a pure function of the data, independent of how many
+processes scanned it. Also covered: the global admission split
+(aggregate bound pinned in the derived worker configs), control-plane
+invalidation fan-out observed by every worker's caches, watermark
+gossip over the bus, and the supervisor's aggregate /metrics and
+/debug surfaces."""
+
+import json
+import os
+import pathlib
+import select
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+T0 = 1_600_000_000
+N_SAMPLES = 50
+N_INSTANCES = 4
+NUM_SHARDS = 4
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_raw(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return r.read()
+
+
+def _get(port, path, **params):
+    return json.loads(_get_raw(port, path, **params))
+
+
+def _post(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}"
+    if qs:
+        url += "?" + qs
+    req = urllib.request.Request(url, data=b"{}", method="POST",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _poll(fn, timeout=150.0, interval=0.2):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+            if ok:
+                return last
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(interval)
+    raise TimeoutError(f"poll timed out; last={last!r}")
+
+
+def _write_corpus(stream_dir):
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.gateway.producer import TestTimeseriesProducer
+    from filodb_tpu.ingest import LogIngestionStream
+    prod = TestTimeseriesProducer(DEFAULT_SCHEMAS,
+                                  num_shards=NUM_SHARDS)
+    streams = {}
+    for sh in range(NUM_SHARDS):
+        path = os.path.join(stream_dir, f"shard={sh}", "stream.log")
+        streams[sh] = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for builders in (prod.gauges(T0 * 1000, N_SAMPLES, N_INSTANCES),
+                     prod.counters(T0 * 1000, N_SAMPLES, N_INSTANCES)):
+        for sh, b in builders.items():
+            for c in b.containers():
+                streams[sh].append(c)
+    for s in streams.values():
+        s.close()
+
+
+def _spawn_supervisor(cfg_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.supervisor",
+         "--config", str(cfg_path)],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    buf = b""
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline and b"\n" not in buf:
+        r, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if r:
+            ch = proc.stdout.read1(4096)
+            if not ch:
+                raise RuntimeError("supervisor died during startup")
+            buf += ch
+    if b"\n" not in buf:
+        proc.kill()
+        raise TimeoutError("no supervisor startup line")
+    return proc, json.loads(buf.split(b"\n", 1)[0])
+
+
+def _stop(proc):
+    proc.terminate()
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
+_QUERY = dict(query='rate({_metric_=~"heap_usage|http_requests_total"}'
+                    '[5m])',
+              start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=60)
+
+
+def _data_bytes(raw: bytes) -> bytes:
+    """The verbatim data section of a response (exact float strings,
+    exact series order). The per-request stats tail (wall-clock
+    timings, cache disposition) legitimately differs between requests —
+    the same boundary the PR 3/5 byte-identity goldens use."""
+    body, sep, _tail = raw.partition(b',"stats":')
+    assert sep, raw[:200]
+    return body
+
+
+def _settled_bytes(port, **extra):
+    return _get_raw(port, "/promql/timeseries/api/v1/query_range",
+                    **{**_QUERY, **extra})
+
+
+def _wait_full(port, want_series):
+    def probe():
+        body = json.loads(_settled_bytes(port, cache="false"))
+        ok = (body.get("status") == "success"
+              and "partial" not in body
+              and len(body["data"]["result"]) >= want_series)
+        return ok, len(body.get("data", {}).get("result", ()))
+    return _poll(probe)
+
+
+def _base_cfg(tmp_path, workers):
+    return {
+        "num-shards": NUM_SHARDS, "port": _free_port(),
+        "serving-workers": workers,
+        "supervisor-port": 0,
+        "run-dir": str(tmp_path / f"run{workers}"),
+        "data-dir": str(tmp_path / "data"),
+        "stream-dir": str(tmp_path / "streams"),
+        "flush-interval-s": 0.4,
+        # settled corpus fully chunk-resident (chunks close at 25
+        # rows): the evaluation path — and therefore the response
+        # bytes — is identical whether the chunks are read by one
+        # process or fetched across the worker peer plane
+        "max-chunks-size": 25,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "grpc-port": None,
+        "max-inflight-queries": 6,
+        "failure-detect-interval-s": 0.3,
+    }
+
+
+def test_multiworker_byte_identical_and_coherent(tmp_path):
+    _write_corpus(str(tmp_path / "streams"))
+    want = 2 * N_INSTANCES
+
+    # -- 1-worker deployment: the golden single-process responses ------
+    cfg1 = _base_cfg(tmp_path, workers=1)
+    p1 = (tmp_path / "sup1.json")
+    p1.write_text(json.dumps(cfg1))
+    proc1, line1 = _spawn_supervisor(p1)
+    try:
+        _wait_full(line1["port"], want)
+        time.sleep(3.0)     # full flush-group rotation: all chunks
+        golden = _data_bytes(_settled_bytes(line1["port"],
+                                            cache="false"))
+        _settled_bytes(line1["port"])               # seed the cache
+        cache_warm = _settled_bytes(line1["port"])  # cache-warm bytes
+        assert _data_bytes(cache_warm) == golden
+    finally:
+        _stop(proc1)
+
+    # -- 2-worker deployment over the same durable dirs ----------------
+    cfg2 = _base_cfg(tmp_path, workers=2)
+    p2 = (tmp_path / "sup2.json")
+    p2.write_text(json.dumps(cfg2))
+    proc2, line2 = _spawn_supervisor(p2)
+    try:
+        pub = line2["port"]
+        sup_port = line2["supervisor_port"]
+        worker_ports = [w["port"] for w in line2["workers"]]
+        assert len(worker_ports) == 2
+
+        # the global admission budget is SPLIT, not multiplied
+        quotas = []
+        for i in range(2):
+            with open(tmp_path / "run2" / f"worker{i}.json") as f:
+                quotas.append(json.load(f)["max-inflight-queries"])
+        assert quotas == [3, 3]     # sum == configured 6, not 12
+
+        for port in worker_ports:
+            _wait_full(port, want)
+
+        # byte-identity: every entry point, cache off and on, equals
+        # the single-process golden
+        def _converged():
+            bodies = [_data_bytes(_settled_bytes(p, cache="false"))
+                      for p in (pub, *worker_ports)]
+            return all(b == golden for b in bodies), \
+                [len(b) for b in bodies]
+        _poll(_converged, timeout=60, interval=0.5)
+        for port in (pub, *worker_ports):
+            _settled_bytes(port)            # seed each entry's cache
+            assert _data_bytes(_settled_bytes(port)) == golden
+
+        # control-plane invalidation fan-out: one operator request at
+        # the supervisor clears EVERY worker's plan/results caches
+        out = _post(sup_port, "/admin/invalidate", reason="e2e-schema")
+        assert out["status"] == "success"
+        assert out["data"]["workers"] == [0, 1]
+
+        def _invalidated():
+            seen = []
+            for port in worker_ports:
+                text = _get_raw(port, "/metrics").decode()
+                seen.append(any(
+                    ln.startswith(
+                        "filodb_plan_cache_invalidations_by_reason_"
+                        'total{reason="e2e-schema"}')
+                    for ln in text.splitlines()))
+            return all(seen), seen
+        _poll(_invalidated, timeout=20, interval=0.2)
+
+        # bus liveness: every worker applied sibling events (topology
+        # transitions at startup, watermark gossip beats)
+        for port in worker_ports:
+            text = _get_raw(port, "/metrics").decode()
+            applied = [float(ln.rsplit(" ", 1)[1])
+                       for ln in text.splitlines()
+                       if ln.startswith(
+                           "filodb_bus_events_applied_total")]
+            assert applied and applied[0] > 0
+            assert "filodb_bus_connected 1" in text.splitlines()
+
+        # watermark gossip over the bus: each worker knows its
+        # sibling's per-shard watermarks (the results-cache freshness
+        # input for fan-out extents)
+        def _gossiped():
+            ok = []
+            for port in worker_ports:
+                h = _get(port, "/__health")
+                ok.append(bool(h.get("watermarks")))
+            return all(ok), ok
+        _poll(_gossiped, timeout=20)
+
+        # supervisor aggregation: merged /metrics carries per-worker
+        # series; /debug/threads merges worker inventories with tags
+        text = _get_raw(sup_port, "/metrics").decode()
+        lines = text.splitlines()
+        assert 'filodb_worker_ordinal{worker="0"} 0' in lines
+        assert 'filodb_worker_ordinal{worker="1"} 1' in lines
+        assert sum(1 for ln in lines
+                   if ln.startswith("# TYPE filodb_plan_cache_entries ")
+                   ) == 1
+        assert "filodb_supervisor_workers 2" in lines
+        threads = _get(sup_port, "/debug/threads")
+        workers_seen = {e.get("worker") for e in threads["data"]}
+        assert workers_seen == {0, 1}
+        names = {e["name"] for e in threads["data"]}
+        assert "worker-supervisor" not in names  # workers' roots only
+        assert "bus-client" in names
+        health = _get(sup_port, "/__health")
+        assert health["bus_connected"] == [0, 1]
+        assert all(w["alive"] and w["ready"]
+                   for w in health["workers"].values())
+    finally:
+        _stop(proc2)
