@@ -757,12 +757,194 @@ def measure_worker_sweep():
     }
 
 
+# -- noisy-neighbor scenario (tenant QoS, query/qos.py) ---------------------
+# One abusive tenant hammering monster scans next to N interactive
+# tenants issuing cheap dashboard queries, measured twice over identical
+# servers: QoS off (the abuser's scans head-of-line block everyone) vs
+# QoS on (the abuser throttles to its budget / degrades; interactive
+# latency stays near the unloaded baseline). The headline number is
+# interactive p99 under load vs the same server unloaded.
+
+NOISY_INTERACTIVE_Q = dict(query="sum(rate(heap_usage[1m]))",
+                           start=T0 + 600, end=T0 + 900, step=30)
+# two monster shapes: sort(...) is results-cache-UNCACHEABLE (order
+# depends on the grid bounds), so every issue is a full recompute —
+# the worst-case scan QoS must throttle; the plain rate(...) matrix is
+# cacheable, so under QoS the brownout's stale rung can answer it
+NOISY_ABUSE_QS = [
+    dict(query='sort(rate({_metric_=~"heap_usage|http_requests_total"}'
+               '[10m]))',
+         start=T0 + 600, end=T0 + SEED_SAMPLES * 10 - 10, step=10),
+    dict(query='rate({_metric_=~"heap_usage|http_requests_total"}'
+               '[10m])',
+         start=T0 + 600, end=T0 + SEED_SAMPLES * 10 - 10, step=10),
+]
+NOISY_ABUSE_BUDGET = [50, 2000]         # rate units/s, burst
+
+
+def _spawn_node(cfg):
+    cfg_dir = tempfile.mkdtemp(prefix="filodb-noisy-cfg-")
+    cfg_path = os.path.join(cfg_dir, "node.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = os.environ.get("FILODB_E2E_PLATFORM", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.server",
+         "--config", cfg_path],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    buf = b""
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline and b"\n" not in buf:
+        r, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if r:
+            ch = proc.stdout.read1(4096)
+            if not ch:
+                raise RuntimeError("node died during startup")
+            buf += ch
+    return proc, json.loads(buf.split(b"\n", 1)[0])
+
+
+def measure_noisy_neighbor(interactive_clients=3, abuse_clients=1,
+                           duration_s=3.0):
+    out = {"interactive_clients": interactive_clients,
+           "abuse_clients": abuse_clients,
+           "abuse_budget": NOISY_ABUSE_BUDGET}
+    for mode in ("qos_off", "qos_on"):
+        port = _free_port()
+        cfg = {
+            "num-shards": 4, "port": port, "gateway-port": None,
+            "seed-dev-data": True, "seed-start-ms": T0 * 1000,
+            "seed-samples": SEED_SAMPLES,
+            "seed-instances": N_INSTANCES,
+            "query-sample-limit": 0, "query-series-limit": 0,
+            "max-inflight-queries": 8,
+            "admission-wait-s": 2.0,
+            "grpc-port": None,
+        }
+        if mode == "qos_on":
+            cfg["qos-tenant-overrides"] = {
+                "abuser": NOISY_ABUSE_BUDGET}
+        proc, _line = _spawn_node(cfg)
+        try:
+            # unloaded interactive baseline (p50/p99 with no abuser)
+            def interactive_once(cl):
+                t0 = time.perf_counter()
+                raw = cl.get_raw(
+                    "/promql/timeseries/api/v1/query_range",
+                    tenant="interactive", **NOISY_INTERACTIVE_Q)
+                dt = time.perf_counter() - t0
+                assert raw.startswith(b'{"status":"success"'), raw[:120]
+                return dt
+
+            cl = KeepAliveClient(port)
+            interactive_once(cl)                # warm compile
+            # warm the cacheable abuse shape's extent under an
+            # UNBUDGETED tenant (the realistic dashboard world): the
+            # abuser's brownout then serves the stale rung for it
+            cl.get_raw("/promql/timeseries/api/v1/query_range",
+                       tenant="warmup", **NOISY_ABUSE_QS[1])
+            cl.close()
+
+            lats, abuse_out = [], {"clean": 0, "shed": 0,
+                                   "throttled": 0, "failed": 0}
+            lock = threading.Lock()
+            t_end = [0.0]
+
+            def interactive_loop(cid):
+                c = KeepAliveClient(port)
+                while time.perf_counter() < t_end[0]:
+                    dt = interactive_once(c)
+                    with lock:
+                        lats.append(dt)
+                c.close()
+
+            def abuse_loop():
+                c = KeepAliveClient(port)
+                i = 0
+                while time.perf_counter() < t_end[0]:
+                    i += 1
+                    # the keep-alive client asserts 200; a 429 raises
+                    # with the status line + body head in the message
+                    try:
+                        raw = c.get_raw(
+                            "/promql/timeseries/api/v1/query_range",
+                            tenant="abuser",
+                            **NOISY_ABUSE_QS[i % len(NOISY_ABUSE_QS)])
+                    except AssertionError as e:
+                        # body fully drained before the raise: the
+                        # keep-alive connection stays usable
+                        with lock:
+                            if "429" in str(e):
+                                abuse_out["throttled"] += 1
+                            else:
+                                abuse_out["failed"] += 1
+                        continue
+                    with lock:
+                        if b'shed(' in raw:
+                            abuse_out["shed"] += 1
+                        else:
+                            abuse_out["clean"] += 1
+                c.close()
+
+            def run_phase(with_abuse):
+                """Interactive percentiles at the SAME interactive
+                concurrency, with/without the abuser — the unloaded
+                baseline must carry the identical client-side load so
+                the ratio isolates the NEIGHBOR, not the GIL."""
+                lats.clear()
+                threads = [threading.Thread(target=interactive_loop,
+                                            args=(c,))
+                           for c in range(interactive_clients)]
+                if with_abuse:
+                    threads += [threading.Thread(target=abuse_loop)
+                                for _ in range(abuse_clients)]
+                t_end[0] = time.perf_counter() + duration_s
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                lats_ms = np.asarray(lats) * 1000
+                return {
+                    "p50_ms":
+                        round(float(np.percentile(lats_ms, 50)), 2),
+                    "p99_ms":
+                        round(float(np.percentile(lats_ms, 99)), 2),
+                    "queries": len(lats),
+                }
+
+            base = run_phase(with_abuse=False)
+            loaded = run_phase(with_abuse=True)
+            out[mode] = {
+                "interactive_unloaded_p50_ms": base["p50_ms"],
+                "interactive_unloaded_p99_ms": base["p99_ms"],
+                "interactive_loaded_p50_ms": loaded["p50_ms"],
+                "interactive_loaded_p99_ms": loaded["p99_ms"],
+                "interactive_queries": loaded["queries"],
+                "abuse": dict(abuse_out),
+                "interactive_p99_vs_unloaded": round(
+                    loaded["p99_ms"] / max(base["p99_ms"], 1e-9), 2),
+            }
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return out
+
+
 def main():
     out = measure()
     try:
         out["worker_sweep"] = measure_worker_sweep()
     except Exception as e:  # noqa: BLE001 — the sweep must not void
         out["worker_sweep"] = {"error": repr(e)}    # the main bench
+    try:
+        out["noisy_neighbor"] = measure_noisy_neighbor()
+    except Exception as e:  # noqa: BLE001
+        out["noisy_neighbor"] = {"error": repr(e)}
     print(json.dumps(out))
 
 

@@ -150,6 +150,15 @@ class CardinalityTracker:
                 return None
         return node
 
+    def series_count(self, prefix: Sequence[str]) -> Optional[int]:
+        """Series count under ``prefix`` (O(depth) — the QoS cost
+        estimator's cardinality input), or None when the prefix has
+        never been seen. An empty prefix answers the shard total."""
+        node = self._node_at(prefix)
+        if node is None:
+            return None
+        return node.ts_count
+
     def scan(self, prefix: Sequence[str], depth: int
              ) -> List[CardinalityRecord]:
         """Records at ``depth`` under ``prefix`` (TsCardinalities plan:

@@ -111,6 +111,26 @@ class TagIndex:
                         del vals[v]
 
     # -- read path --------------------------------------------------------
+    def posting_upper_bound(self, filters: Sequence[ColumnFilter]
+                            ) -> Optional[int]:
+        """Cheap (O(#filters), no set intersection) upper bound on the
+        series an equality-filter set can match: the smallest posting
+        list among the eq filters. None when no eq filter names an
+        indexed label — the caller falls back to its cardinality-tree
+        estimate. This is the QoS cost estimator's tag-index input; it
+        must stay cheap enough to run BEFORE admission."""
+        best: Optional[int] = None
+        for f in filters:
+            if getattr(f, "op", "") != "eq":
+                continue
+            vals = self._postings.get(f.label)
+            if vals is None:
+                continue
+            n = len(vals.get(f.value, ()))
+            if best is None or n < best:
+                best = n
+        return best
+
     def _ids_for_filter(self, f: ColumnFilter) -> Set[int]:
         vals = self._postings.get(f.label, {})
         if f.op == "eq":

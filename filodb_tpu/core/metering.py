@@ -56,6 +56,23 @@ class TenantMetering:
             return None
         return time.monotonic() - self.last_snapshot_t
 
+    def count_for(self, prefix: Tuple[str, ...]) -> Optional[int]:
+        """Series count for a (ws[, ns]) prefix from the latest
+        snapshot, or None when the prefix has never appeared. The QoS
+        cost estimator reads this to price REMOTE shard groups (local
+        cardinality trackers only know local shards; the metering
+        snapshot is the node's aggregated per-tenant view)."""
+        latest = self.latest                    # atomic snapshot ref
+        if not latest:
+            return None
+        total = 0
+        found = False
+        for pfx, (t, _a) in latest.items():
+            if pfx[:len(prefix)] == tuple(prefix):
+                total += t
+                found = True
+        return total if found else None
+
     def snapshot_once(self) -> None:
         agg: Dict[Tuple[str, ...], Tuple[int, int]] = {}
         for tracker in list(self.trackers.values()):

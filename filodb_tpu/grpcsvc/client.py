@@ -24,6 +24,7 @@ import numpy as np
 
 from filodb_tpu.grpcsvc import wire
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.query import qos
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
@@ -154,12 +155,17 @@ class GrpcShardGroup:
         def dial(timeout_s: float) -> bytes:
             # payload re-encoded per attempt: a retry must forward the
             # REMAINING budget, not the original one (the trace context
-            # is re-read too: the parent is the live attempt span)
+            # is re-read too: the parent is the live attempt span).
+            # Tenant/priority ride along so the peer force-charges the
+            # same budget and orders its batcher by the same class.
+            qctx = qos.current()
             payload = wire.encode_raw_request(
                 self.dataset, filters, start_ms, end_ms, column,
                 self.shard_nums, span_snap=bool(full),
                 deadline_ms=self._deadline_ms(),
-                trace_ctx=obs_trace.inject_header() or "")
+                trace_ctx=obs_trace.inject_header() or "",
+                tenant=qctx.tenant if qctx is not None else "",
+                priority=qctx.priority if qctx is not None else 0)
             return _call(self.addr, "FetchRaw", payload, timeout_s,
                          self.node_id)
 
@@ -253,6 +259,8 @@ class GrpcRemoteExec:
 
         def dial(timeout_s: float) -> bytes:
             # re-encoded per attempt: forward the REMAINING budget
+            # (tenant/priority ride fields 13/14 — budget inheritance)
+            qctx = qos.current()
             payload = wire.encode_exec_request(
                 self.dataset, self.query, self.start_ms, self.step_ms,
                 self.end_ms, local_only=self.local_only,
@@ -261,7 +269,9 @@ class GrpcRemoteExec:
                 trace_ctx=obs_trace.inject_header() or "",
                 no_cache=self.no_cache,
                 expect_shards=(self.expect_shards
-                               if self.local_only else None))
+                               if self.local_only else None),
+                tenant=qctx.tenant if qctx is not None else "",
+                priority=qctx.priority if qctx is not None else 0)
             return _call(self.addr, "Exec", payload, timeout_s,
                          self.node_id)
 

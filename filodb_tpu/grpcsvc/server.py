@@ -21,8 +21,21 @@ from typing import Optional
 from filodb_tpu.grpcsvc import wire
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.query import qos
 
 _SERVICE = "filodb.QueryService"
+
+
+def _req_qos(req) -> Optional[qos.QosContext]:
+    """QoS context of a peer hop: tenant/priority decoded off the wire,
+    ``forced`` set — the ENTRY node made the admission decision, this
+    leg only inherits the charge and the batcher ordering. None when
+    the caller sent no tenant (pre-QoS peer or budgets off)."""
+    if not req.get("tenant"):
+        return None
+    return qos.QosContext(tenant=req["tenant"],
+                          priority=int(req.get("priority") or 0),
+                          forced=True)
 
 
 @guarded_by("_rpc_lock", "rpcs_served")
@@ -104,7 +117,20 @@ class GrpcQueryServer:
         try:
             req = wire.decode_raw_request(request)
             tr = self._req_trace(req)
-            with obs_trace.activate(tr), \
+            qctx = _req_qos(req)
+            adm = getattr(self.http, "admission", None)
+            if qctx is not None and adm is not None \
+                    and adm.budgets.enabled:
+                # budget inheritance: the leg's cost lands on the same
+                # tenant bucket the entry node charged (forced — a leg
+                # must never shed mid-query)
+                shards = self.http.shards_by_dataset.get(
+                    req["dataset"], ())
+                adm.budgets.charge_forced(
+                    qctx.tenant, qos.estimate_leaf_cost(
+                        req["filters"], shards, req["start_ms"],
+                        req["end_ms"]))
+            with qos.activate(qctx), obs_trace.activate(tr), \
                     obs_trace.span("peer-fetch-raw",
                                    node=getattr(self.http, "node_id", "")
                                    or "", dataset=req["dataset"]):
@@ -173,7 +199,8 @@ class GrpcQueryServer:
                 return wire.encode_exec_response(
                     None, error=f"dataset {req['dataset']} not set up",
                     trace_spans=obs_trace.spans_wire(tr))
-            with obs_trace.activate(tr), \
+            qctx = _req_qos(req)
+            with qos.activate(qctx), obs_trace.activate(tr), \
                     obs_trace.span("peer-exec",
                                    node=getattr(self.http, "node_id", "")
                                    or "", dataset=req["dataset"]):
@@ -191,6 +218,14 @@ class GrpcQueryServer:
                 else:
                     plan = parse_query(req["query"],
                                        req["start_ms"] // 1000)
+                adm = getattr(self.http, "admission", None)
+                if qctx is not None and adm is not None \
+                        and adm.budgets.enabled:
+                    # budget inheritance on the exec plane: forced —
+                    # the entry node already made the shed decision
+                    adm.budgets.charge_forced(
+                        qctx.tenant,
+                        engine.estimate_cost(plan).total)
                 rc = getattr(self.http, "result_cache", None)
                 if rc is not None and not req["plan_wire"] \
                         and req["step_ms"] > 0:

@@ -16,7 +16,9 @@ Messages (field numbers):
   RawRequest    {1: dataset, 2: Filter*, 3: start_ms, 4: end_ms,
                  5: column, 6: shards packed, 7: span_snap,
                  8: deadline_ms (caller's remaining budget; 0 = none),
-                 9: trace ctx "trace_id-parent_span-1" (absent = untraced)}
+                 9: trace ctx "trace_id-parent_span-1" (absent = untraced),
+                 10: tenant (QoS budget inheritance; absent = default),
+                 11: priority class (absent = interactive)}
   SnapKey       {1: node, 2: ds, 3: shard, 4: part, 5: num_chunks,
                  6: col, 7: start_ms, 8: end_ms}
   Srv           {1: label entry {1:k,2:v}*, 2: n, 3: ts nibble,
@@ -30,7 +32,9 @@ Messages (field numbers):
                  10: trace ctx "trace_id-parent_span-1",
                  11: no_cache (results-cache bypass propagation),
                  12: expect_shards packed (stale-routing guard on
-                 local_only pushdown hops)}
+                 local_only pushdown hops),
+                 13: tenant (QoS budget inheritance; absent = default),
+                 14: priority class (absent = interactive)}
   ExecSeries    {1: label entry*, 2: values nibble (grid-aligned,
                  NaN where absent), 3: hist nibble flat, 4: nb}
   ExecResponse  {1: ExecSeries*, 2: error, 3: steps nibble,
@@ -111,7 +115,9 @@ def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
                        shards: Optional[Sequence[int]],
                        span_snap: bool = True,
                        deadline_ms: int = 0,
-                       trace_ctx: str = "") -> bytes:
+                       trace_ctx: str = "",
+                       tenant: str = "",
+                       priority: int = 0) -> bytes:
     out = bytearray(_ld(1, dataset.encode()))
     for f in filters:
         out += _ld(2, _ld(1, f.label.encode()) + _ld(2, f.op.encode())
@@ -126,6 +132,10 @@ def encode_raw_request(dataset: str, filters, start_ms: int, end_ms: int,
         out += _vi(8, int(deadline_ms))
     if trace_ctx:
         out += _ld(9, trace_ctx.encode())
+    if tenant:
+        out += _ld(10, tenant.encode())
+    if priority:
+        out += _vi(11, int(priority))
     return bytes(out)
 
 
@@ -133,7 +143,7 @@ def decode_raw_request(buf: bytes) -> Dict:
     from filodb_tpu.core.index import ColumnFilter
     req = {"dataset": "", "filters": [], "start_ms": 0, "end_ms": 0,
            "column": None, "shards": None, "span_snap": True,
-           "deadline_ms": 0, "trace": ""}
+           "deadline_ms": 0, "trace": "", "tenant": "", "priority": 0}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -165,6 +175,10 @@ def decode_raw_request(buf: bytes) -> Dict:
             req["deadline_ms"] = _signed(v)
         elif f == 9:
             req["trace"] = v.decode()
+        elif f == 10:
+            req["tenant"] = v.decode()
+        elif f == 11:
+            req["priority"] = _signed(v)
     return req
 
 
@@ -293,7 +307,9 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
                         deadline_ms: int = 0,
                         trace_ctx: str = "",
                         no_cache: bool = False,
-                        expect_shards=None) -> bytes:
+                        expect_shards=None,
+                        tenant: str = "",
+                        priority: int = 0) -> bytes:
     """Field 8 carries a STRUCTURAL LogicalPlan tree (query.planwire) —
     the reference's exec_plan.proto capability; the printed query text
     stays alongside for debuggability and older peers. Field 9 carries
@@ -318,6 +334,10 @@ def encode_exec_request(dataset: str, query: str, start_ms: int,
     if expect_shards:
         out += _ld(12, b"".join(_uvarint(int(s))
                                 for s in expect_shards))
+    if tenant:
+        out += _ld(13, tenant.encode())
+    if priority:
+        out += _vi(14, int(priority))
     return out
 
 
@@ -325,7 +345,7 @@ def decode_exec_request(buf: bytes) -> Dict:
     req = {"dataset": "", "query": "", "start_ms": 0, "step_ms": 0,
            "end_ms": 0, "local_only": True, "plan_wire": b"",
            "deadline_ms": 0, "trace": "", "no_cache": False,
-           "expect_shards": None}
+           "expect_shards": None, "tenant": "", "priority": 0}
     for f, _, v in _fields(buf):
         if f == 1:
             req["dataset"] = v.decode()
@@ -353,6 +373,10 @@ def decode_exec_request(buf: bytes) -> Dict:
                 s, pos = _read_uvarint(v, pos)
                 shards.append(s)
             req["expect_shards"] = shards
+        elif f == 13:
+            req["tenant"] = v.decode()
+        elif f == 14:
+            req["priority"] = _signed(v)
     return req
 
 

@@ -21,7 +21,7 @@ import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from filodb_tpu.http import prom_json
 from filodb_tpu.lint.threads import thread_root
@@ -34,6 +34,8 @@ from filodb_tpu.parallel.resilience import (Deadline, DeadlineExceeded,
 from filodb_tpu.promql.parser import (TimeStepParams, parse_query,
                                       parse_query_range, selector_to_filters)
 from filodb_tpu.query import logical as lp
+from filodb_tpu.query import qos
+from filodb_tpu.testing import chaos
 from filodb_tpu.query.engine import QueryEngine  # noqa: F401 (re-export)
 from filodb_tpu.query.planner import QueryPlanner
 from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
@@ -87,6 +89,10 @@ class FiloHttpServer:
                  results_cache_mb: float = 64.0,
                  results_cache_hot_window_ms: float = 10_000.0,
                  max_inflight_queries: int = 4,
+                 admission_wait_s: float = 5.0,
+                 qos_budgets: Optional[qos.TenantBudgets] = None,
+                 qos_degrade_max_steps: int = 64,
+                 qos_shed_degraded: bool = True,
                  tracer: Optional[Tracer] = None,
                  slow_query_ms: float = 1000.0,
                  slow_query_capacity: int = 128,
@@ -149,15 +155,31 @@ class FiloHttpServer:
         self.slow_log = SlowQueryLog(threshold_ms=float(slow_query_ms),
                                      capacity=int(slow_query_capacity))
         self.inflight = InflightRegistry()
-        # admission control on the QUERY endpoints: with hundreds of
-        # keep-alive connections, unbounded in-flight handlers thrash
-        # the GIL (every runnable thread pays switch-interval
-        # preemptions); excess requests park on a semaphore (futex, no
-        # spin) and are admitted FIFO-ish as slots free. Metadata,
-        # health, and cluster-plane endpoints bypass it.
-        self._query_gate = threading.BoundedSemaphore(
-            max(1, int(max_inflight_queries))) \
-            if max_inflight_queries else None
+        # admission control on the QUERY endpoints (query/qos.py): with
+        # hundreds of keep-alive connections, unbounded in-flight
+        # handlers thrash the GIL (every runnable thread pays switch-
+        # interval preemptions); excess requests park on the
+        # controller's semaphore and are admitted FIFO-ish as slots
+        # free — but the wait is BOUNDED (admission_wait_s): saturation
+        # answers 429 + Retry-After instead of hanging until the
+        # client's own timeout. Per-tenant token-bucket budgets make
+        # the shed SELECTIVE: the over-budget tenant degrades/throttles
+        # while everyone else sails through. Metadata, health, and
+        # cluster-plane endpoints bypass the gate.
+        self.admission = qos.AdmissionController(
+            max_inflight=max(1, int(max_inflight_queries))
+            if max_inflight_queries else 0,
+            wait_s=float(admission_wait_s),
+            budgets=qos_budgets)
+        # brownout ladder knobs: coarsen rung targets at most this many
+        # evaluation steps; False turns the whole ladder off (over-
+        # budget goes straight to 429)
+        self.qos_degrade_max_steps = int(qos_degrade_max_steps)
+        self.qos_shed_degraded = bool(qos_shed_degraded)
+        # set by the standalone server: TenantMetering (per-tenant
+        # cardinality gauges; also the cost estimator's fan-out
+        # cardinality view via make_planner)
+        self.tenant_metering = None
         # serving fast path: parsed-plan LRU (start/end abstracted out of
         # the key; dashboards re-issuing the same text skip parse+plan).
         # Invalidation: shard-topology events from the mapper, plus the
@@ -338,6 +360,7 @@ class FiloHttpServer:
     # threads
     @thread_root("http-handler")
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        retry_after_s: Optional[float] = None
         try:
             parsed = urllib.parse.urlparse(req.path)
             qs = urllib.parse.parse_qs(parsed.query)
@@ -362,10 +385,19 @@ class FiloHttpServer:
             # trace and ship them back in the response envelope
             tctx = obs_trace.parse_context(
                 req.headers.get(obs_trace.HEADER))
-            code, payload = self._route(parsed.path, qs, body_json,
-                                        body_raw, tctx=tctx)
+            code, payload = self._route(
+                parsed.path, qs, body_json, body_raw, tctx=tctx,
+                tenant_hdr=req.headers.get(qos.TENANT_HEADER),
+                priority_hdr=req.headers.get(qos.PRIORITY_HEADER))
         except _Handled:
             pass
+        except qos.AdmissionRejected as e:
+            # admission said no and no degraded answer exists: 429 +
+            # Retry-After. Distinct from the 503 deadline path below —
+            # a rejected query was never executed, so the client can
+            # back off and resubmit as-is.
+            code, payload = 429, prom_json.error(str(e), "throttled")
+            retry_after_s = e.retry_after_s
         except QueryLimitError as e:
             code, payload = 422, prom_json.error(str(e), "query_limit")
         except DeadlineExceeded as e:
@@ -377,6 +409,9 @@ class FiloHttpServer:
         except Exception as e:   # noqa: BLE001 — edge must not crash
             code, payload = 500, prom_json.error(str(e), "internal")
         extra_headers = {}
+        if retry_after_s is not None:
+            extra_headers["Retry-After"] = str(
+                max(1, int(retry_after_s + 0.999)))
         if isinstance(payload, prom_json.PreEncoded):
             body = payload.body
             ctype = payload.ctype
@@ -399,7 +434,9 @@ class FiloHttpServer:
         req.wfile.write(body)
 
     def _route(self, path: str, qs: Dict, body_json=None,
-               body_raw: bytes = b"", tctx=None):
+               body_raw: bytes = b"", tctx=None,
+               tenant_hdr: Optional[str] = None,
+               priority_hdr: Optional[str] = None):
         if path in ("/__health", "/__liveness", "/__readiness"):
             # the health body doubles as status gossip: locally-served
             # shards with their FSM status (peers sync these instead of
@@ -532,13 +569,41 @@ class FiloHttpServer:
         else:
             fn = None
         if fn is not None:
-            if self._query_gate is None:
-                code, payload = self._run_query_routing_retry(
-                    mk_engine, fn)
-            else:
-                with self._query_gate:
-                    code, payload = self._run_query_routing_retry(
-                        mk_engine, fn)
+            # tenant QoS: identity from &tenant= / X-Filo-Tenant (by
+            # convention the workspace), priority class from
+            # &priority= / X-Filo-Priority. A dispatch=local hop is a
+            # fan-out LEG: the entry node already made the admission
+            # decision, so the leg force-charges and never sheds.
+            qctx = qos.QosContext(
+                tenant=(self._param(qs, "tenant") or tenant_hdr
+                        or qos.DEFAULT_TENANT),
+                priority=qos.parse_priority(
+                    self._param(qs, "priority") or priority_hdr),
+                forced=local_dispatch)
+            chaos.fire("qos.admit", tenant=qctx.tenant, endpoint=rest)
+            adm = self.admission
+            try:
+                if adm is None or not adm.gated:
+                    with qos.activate(qctx):
+                        code, payload = self._run_query_routing_retry(
+                            mk_engine, fn)
+                else:
+                    with adm.slot(tenant=qctx.tenant):
+                        with qos.activate(qctx):
+                            code, payload = \
+                                self._run_query_routing_retry(
+                                    mk_engine, fn)
+            except qos.AdmissionRejected as e:
+                # host saturation leaves one free rung: a stale cached
+                # extent costs neither a slot nor compute. Over-budget
+                # rejections already walked the full ladder — re-raise.
+                if e.reason != "saturated" or rest != "query_range":
+                    raise
+                out = self._shed_stale_saturated(ds, qs, qctx, deadline,
+                                                 no_cache)
+                if out is None:
+                    raise
+                code, payload = out
             if local_dispatch and isinstance(payload, dict) \
                     and self.shard_mapper is not None \
                     and hasattr(self.shard_mapper, "topology_epoch"):
@@ -676,6 +741,237 @@ class FiloHttpServer:
                         f"{attempts} attempts: {e.detail or e}")
                 _time.sleep(0.05 * (i + 1))
 
+    # -- tenant QoS: cost admission + the shed-to-degraded ladder ---------
+    def _charge_or_shed(self, engine, qs, ds: str, query: str, plan,
+                        start: int, end: int, step: int,
+                        stages: Dict) -> Optional[Tuple[int, object]]:
+        """Charge the parsed plan's estimated cost to the tenant's
+        budget. Returns None when the query may proceed normally, a
+        ``(code, payload)`` degraded answer when the tenant is over
+        budget but the ladder produced one, and raises
+        :class:`~filodb_tpu.query.qos.AdmissionRejected` (429 +
+        Retry-After) when it did not."""
+        adm = self.admission
+        qctx = qos.current()
+        if adm is None or qctx is None or not adm.budgets.enabled:
+            return None
+        bucket = adm.budgets.bucket(qctx.tenant)
+        if bucket is None:
+            return None                     # unbudgeted tenant
+        if qctx.forced:
+            # fan-out leg: inherit the entry node's charge, never shed
+            bucket.charge_forced(engine.estimate_cost(plan).total)
+            return None
+        if bucket.remaining() <= 0.0:
+            # drained-bucket fast path: nothing can charge, so skip
+            # plan pricing entirely — a tight-loop abuser ignoring
+            # Retry-After must not buy repeated cost walks with each
+            # rejection. Only the (charged) stale rung can answer.
+            bucket.note_throttled()
+            qctx.degraded = True
+            qctx.priority = qos.PRIORITY_BEST_EFFORT
+            out = self._shed_degraded(engine, qs, ds, query, plan,
+                                      start, end, step, stages,
+                                      drained=True)
+            if out is not None:
+                return out
+            adm.budgets.record_rejected(qctx.tenant)
+            raise qos.AdmissionRejected(
+                f"tenant {qctx.tenant!r} has exhausted its query "
+                f"budget and no degraded answer exists",
+                retry_after_s=bucket.retry_after_s(bucket.burst),
+                tenant=qctx.tenant, reason="over-budget")
+        cost = engine.estimate_cost(plan).total
+        stages["qosCost"] = round(cost, 1)
+        if bucket.try_charge(cost):
+            return None
+        # over budget: the tenant's own work degrades; everyone else
+        # is untouched. Executions below run at best-effort priority so
+        # the batcher never lets them head-of-line block interactive
+        # queries.
+        qctx.degraded = True
+        qctx.priority = qos.PRIORITY_BEST_EFFORT
+        obs_trace.event("qos-shed", tenant=qctx.tenant,
+                        cost=round(cost, 1))
+        out = self._shed_degraded(engine, qs, ds, query, plan,
+                                  start, end, step, stages)
+        if out is not None:
+            return out
+        adm.budgets.record_rejected(qctx.tenant)
+        raise qos.AdmissionRejected(
+            f"tenant {qctx.tenant!r} is over its query budget "
+            f"(estimated cost {cost:.0f}) and no degraded answer "
+            f"exists",
+            retry_after_s=adm.budgets.retry_after_s(qctx.tenant, cost),
+            tenant=qctx.tenant, reason="over-budget")
+
+    def _shed_degraded(self, engine, qs, ds: str, query: str, plan,
+                       start: int, end: int, step: int,
+                       stages: Dict, drained: bool = False
+                       ) -> Optional[Tuple[int, object]]:
+        """The brownout ladder, in order of preference:
+
+        1. **stale-cache** — an overlapping results-cache extent served
+           past the freshness horizon (costs nothing; correctness
+           invalidators still apply — stale, never wrong);
+        2. **downsample** — re-plan at a coarser step through the
+           normal materialize path, which routes the bigger step
+           through the raw/downsample tiering where available;
+        3. **partial** — evaluate only the newest slice of the range
+           and return it via the partial-results plumbing.
+
+        Rungs 2-3 still charge their (much smaller) estimated cost —
+        a tenant deep in debt gets neither. Every rung stamps a
+        ``shed(...)`` warning naming itself, so clients and dashboards
+        see exactly what they got. Returns None when no rung applies
+        (the caller answers 429 + Retry-After)."""
+        qctx = qos.current()
+        tenant = qctx.tenant if qctx is not None else qos.DEFAULT_TENANT
+        budgets = self.admission.budgets
+        if not self.qos_shed_degraded or step <= 0:
+            return None
+        start_ms, step_ms, end_ms = start * 1000, step * 1000, end * 1000
+        chaos.fire("qos.shed", tenant=tenant, query=query)
+        # rung 1: stale cache (skipped when the client explicitly sent
+        # &cache=false — the escape hatch means "never answer me from
+        # cached state", stale least of all)
+        bypass = (self._param(qs, "cache", "")
+                  or "").lower() in ("false", "0", "no")
+        grid = None if bypass else \
+            self.result_cache.stale_serve(engine, ds, query, plan,
+                                          start_ms, step_ms, end_ms)
+        if grid is not None and budgets.try_charge(
+                tenant, qos.stale_serve_cost(grid.num_series,
+                                             grid.values.shape[1])):
+            # a stale serve is cheap but not free (encode-only cost
+            # charged above): the budget bounds the tenant's TOTAL
+            # work, degraded serving included
+            grid.warnings.append(
+                f"shed(stale-cache): tenant {tenant!r} over budget; "
+                f"served cached extent past the freshness horizon")
+            budgets.record_degraded(tenant, "stale")
+            obs_trace.event("qos-shed", rung="stale", tenant=tenant)
+            stages["qosShed"] = "stale"
+            return 200, self._encode_degraded(engine, grid, qs)
+        if drained:
+            # deep debt: the compute rungs below could never charge —
+            # don't pay their plan walks either
+            return None
+        from filodb_tpu.query.engine import lp_replace_range
+        # rung 2: coarser resolution through the tiering path
+        coarse = qos.coarsen_step_s(start, step, end,
+                                    self.qos_degrade_max_steps)
+        if coarse > step:
+            plan_b = lp_replace_range(plan, start_ms, coarse * 1000,
+                                      end_ms)
+            if budgets.try_charge(tenant,
+                                  engine.estimate_cost(plan_b).total):
+                budgets.record_degraded(tenant, "downsample")
+                obs_trace.event("qos-shed", rung="downsample",
+                                tenant=tenant)
+                res = engine.materialize(plan_b).execute()
+                stages["qosShed"] = "downsample"
+                if isinstance(res, GridResult):
+                    res.warnings.append(
+                        f"shed(downsample): tenant {tenant!r} over "
+                        f"budget; step coarsened {step}s -> {coarse}s")
+                    return 200, self._encode_degraded(engine, res, qs)
+                if isinstance(res, ScalarResult):
+                    return 200, prom_json.scalar(res, instant=False)
+        # rung 3: newest-slice partial
+        n_steps = (end - start) // step + 1
+        if n_steps > 4:
+            keep = max(1, n_steps // 8)
+            start_c = start + (n_steps - keep) * step
+            plan_c = lp_replace_range(plan, start_c * 1000, step_ms,
+                                      end_ms)
+            if budgets.try_charge(tenant,
+                                  engine.estimate_cost(plan_c).total):
+                budgets.record_degraded(tenant, "partial")
+                obs_trace.event("qos-shed", rung="partial",
+                                tenant=tenant)
+                res = engine.materialize(plan_c).execute()
+                stages["qosShed"] = "partial"
+                if isinstance(res, GridResult):
+                    res.partial = True
+                    res.warnings.append(
+                        f"shed(partial): tenant {tenant!r} over "
+                        f"budget; returned newest {keep}/{n_steps} "
+                        f"steps")
+                    return 200, self._encode_degraded(engine, res, qs)
+                if isinstance(res, ScalarResult):
+                    return 200, prom_json.scalar(res, instant=False)
+        return None
+
+    def _shed_stale_saturated(self, ds: str, qs: Dict, qctx,
+                              deadline, no_cache: bool
+                              ) -> Optional[Tuple[int, object]]:
+        """Host-saturation fallback: the bounded admission wait timed
+        out, but a stale cached extent needs neither a slot nor
+        compute — parse (plan cache) and look it up. None when there
+        is no usable extent (the caller answers 429)."""
+        if no_cache or not self.qos_shed_degraded:
+            return None
+        query = self._param(qs, "query")
+        if not query:
+            return None
+        try:
+            start = int(float(self._param(qs, "start", "0")))
+            end = int(float(self._param(qs, "end", "0")))
+            step = int(float(self._param(qs, "step", "10")))
+        except ValueError:
+            return None
+        if step <= 0 or end < start:
+            return None
+        engine = self.make_planner(ds, deadline=deadline)
+        if engine is None:
+            return None
+        plan = self.plan_cache.lookup(ds, query, start * 1000,
+                                      step * 1000, end * 1000)
+        if plan is None:
+            plan = parse_query_range(query,
+                                     TimeStepParams(start, step, end))
+            self.plan_cache.store(ds, query, start * 1000, step * 1000,
+                                  end * 1000, plan)
+        grid = self.result_cache.stale_serve(
+            engine, ds, query, plan, start * 1000, step * 1000,
+            end * 1000)
+        if grid is None:
+            return None
+        if not self.admission.budgets.try_charge(
+                qctx.tenant, qos.stale_serve_cost(
+                    grid.num_series, grid.values.shape[1])):
+            return None         # budget bounds degraded serving too
+        grid.warnings.append(
+            "shed(stale-cache): host saturated; served cached extent "
+            "past the freshness horizon")
+        self.admission.budgets.record_degraded(qctx.tenant, "stale")
+        return 200, self._encode_degraded(engine, grid, qs)
+
+    def _encode_degraded(self, engine, res: GridResult, qs):
+        """Encode a shed-ladder result. Degraded answers are exactly
+        what a brownout serves in VOLUME, so the bulk matrix path
+        (pre-encoded bytes, memoized fragments) matters here too; the
+        warnings/partial markers ride the envelope on both paths.
+        Never admitted to the results cache (the shed warning trips the
+        degraded guard — these must not poison healthy queries)."""
+        hist_wire = bool(self._param(qs, "hist-wire"))
+        stats_json = self._query_stats(engine, res)
+        if isinstance(res, GridResult) and not hist_wire \
+                and not res.is_hist():
+            st = engine.stats
+            warnings = list(getattr(st, "warnings", ()) or ())
+            warnings.extend(w for w in res.warnings
+                            if w not in warnings)
+            partial = bool(getattr(st, "partial", False) or res.partial)
+            return prom_json.matrix_bytes(res, stats_json,
+                                          warnings=warnings,
+                                          partial=partial)
+        out = prom_json.matrix(res, hist_wire=hist_wire)
+        out["stats"] = stats_json
+        prom_json.attach_degraded(out, res, engine.stats)
+        return out
+
     def make_planner(self, ds: str, local_dispatch: bool = False,
                      deadline: Optional[Deadline] = None,
                      allow_partial: bool = False,
@@ -702,7 +998,7 @@ class FiloHttpServer:
                 url = self.peers.get(node)
                 if url and node not in down:
                     handoff[sh] = (node, url)
-        return QueryPlanner(shards, backend=self.backend,
+        planner = QueryPlanner(shards, backend=self.backend,
                             handoff_sources=handoff,
                             peer_watermarks=self.peer_watermarks,
                             deadline=deadline,
@@ -724,6 +1020,10 @@ class FiloHttpServer:
                             grpc_peers=grpc_peers,
                             grpc_partitions=grpc_partitions,
                             local_dispatch=local_dispatch)
+        # QoS cost estimation: the metering snapshot prices remote
+        # shard groups (local trackers only know local shards)
+        planner.metering = self.tenant_metering
+        return planner
 
     def invalidate_plan_cache(self, reason: str = "schema") -> None:
         """Explicit plan-cache invalidation hook. Topology changes flow
@@ -827,6 +1127,16 @@ class FiloHttpServer:
             pc_state = "hit" if cached else \
                 ("miss" if self.plan_cache.enabled else "off")
             sp.tag(plan_cache=pc_state)
+        # cost-based tenant admission (query/qos.py): price the parsed
+        # plan BEFORE any execution and charge the tenant's token
+        # bucket. Fan-out legs (dispatch=local) force-charge — the
+        # entry node already decided; an over-budget entry query walks
+        # the degrade ladder (stale-cache -> downsample -> partial) and
+        # only 429s when no degraded answer exists.
+        out = self._charge_or_shed(engine, qs, ds, query, plan,
+                                   start, end, step, stages)
+        if out is not None:
+            return out
         t1 = _time.perf_counter()
         self.inflight.stage(entry, "plan")
         bypass = (self._param(qs, "cache", "")
@@ -965,6 +1275,13 @@ class FiloHttpServer:
                 plan = parse_query(query, time_s)
                 self.plan_cache.store(ds, query, time_s * 1000, 0,
                                       time_s * 1000, plan)
+        # cost admission: instant queries charge too, but there is no
+        # range to stale-serve/coarsen/trim — over budget means 429
+        # (step=0 makes the ladder decline)
+        out = self._charge_or_shed(engine, qs, ds, query, plan,
+                                   time_s, time_s, 0, stages)
+        if out is not None:
+            return out
         t1 = _time.perf_counter()
         self.inflight.stage(entry, "execute")
         with obs_trace.span("execute"):
@@ -1216,6 +1533,43 @@ class FiloHttpServer:
         "filodb_bus_connected":
             "1 while the worker's bus client is connected to the "
             "supervisor's control plane",
+        "filodb_result_cache_stale_serves_total":
+            "Brownout stale-cache rung: extents served past the "
+            "freshness horizon to an over-budget tenant / saturated "
+            "host",
+        "filodb_admission_max_inflight":
+            "Admission slots (host bound; a supervisor splits the "
+            "host total across workers)",
+        "filodb_admission_inflight":
+            "Queries currently holding an admission slot",
+        "filodb_admission_wait_timeouts_total":
+            "Bounded admission waits that timed out (slot never "
+            "freed within admission-wait-s)",
+        "filodb_admission_rejected_total":
+            "Queries answered 429 at the saturation gate",
+        "filodb_tenant_budget_remaining":
+            "Per-tenant token-bucket balance (cost units; negative = "
+            "debt from forced fan-out charges)",
+        "filodb_tenant_budget_rate":
+            "Per-tenant budget refill rate (cost units/s)",
+        "filodb_tenant_cost_charged_total":
+            "Estimated cost units charged to the tenant (admitted + "
+            "forced)",
+        "filodb_tenant_admitted_total":
+            "Queries the tenant's budget admitted cleanly",
+        "filodb_tenant_throttled_total":
+            "Budget charges refused (query entered the degrade "
+            "ladder)",
+        "filodb_tenant_forced_charges_total":
+            "Fan-out leg charges inherited from an entry node",
+        "filodb_tenant_degraded_total":
+            "Degraded answers served, by ladder rung "
+            "(stale/downsample/partial)",
+        "filodb_tenant_rejected_total":
+            "Tenant queries answered 429 (over budget, no degraded "
+            "answer existed)",
+        "filodb_batcher_priority_queries_total":
+            "Batcher dispatches by priority class (tenant QoS)",
         "filodb_traces_started_total": "Traces started on this node",
         "filodb_traces_stored": "Finished traces in /debug/traces",
         "filodb_slow_queries_total": "Queries over the slow-query "
@@ -1302,6 +1656,10 @@ class FiloHttpServer:
                 emit("batcher_occupancy_max", {}, bs["occupancy_max"])
                 emit("batcher_gather_wait_ms_total", {},
                      bs["gather_wait_ms"])
+                for cls, n in sorted(bs.get("by_priority",
+                                            {}).items()):
+                    emit("batcher_priority_queries_total",
+                         {"class": cls}, n)
         pc = self.plan_cache.snapshot()
         emit("plan_cache_entries", {}, pc["entries"])
         emit("plan_cache_hits_total", {}, pc["hits"])
@@ -1335,6 +1693,37 @@ class FiloHttpServer:
              rc["cached_steps_served"])
         emit("result_cache_computed_steps_served_total", {},
              rc["computed_steps_served"])
+        emit("result_cache_stale_serves_total", {},
+             rc.get("stale_serves", 0))
+        # tenant QoS: admission-gate counters + per-tenant budget
+        # families (the supervisor sums these host-wide)
+        adm = self.admission
+        if adm is not None:
+            asnap = adm.snapshot()
+            emit("admission_max_inflight", {}, asnap["max_inflight"])
+            emit("admission_inflight", {}, asnap["inflight"])
+            emit("admission_wait_timeouts_total", {},
+                 asnap["wait_timeouts"])
+            emit("admission_rejected_total", {},
+                 asnap["slot_rejections"])
+            for tenant, t in sorted(adm.budgets.snapshot().items()):
+                lbl = {"tenant": tenant}
+                if "remaining" in t:
+                    emit("tenant_budget_remaining", lbl,
+                         t["remaining"])
+                    emit("tenant_budget_rate", lbl, t["rate"])
+                    emit("tenant_cost_charged_total", lbl,
+                         round(t["charged_total"], 3))
+                    emit("tenant_admitted_total", lbl, t["admitted"])
+                    emit("tenant_throttled_total", lbl,
+                         t["throttled"])
+                    emit("tenant_forced_charges_total", lbl,
+                         t["forced_charges"])
+                for rung, n in sorted(t.get("degraded", {}).items()):
+                    emit("tenant_degraded_total",
+                         {**lbl, "rung": rung}, n)
+                if t.get("rejected"):
+                    emit("tenant_rejected_total", lbl, t["rejected"])
         # elastic membership: topology epoch, handoff/adoption state,
         # stale-routing bounce/retry counters, detector liveness
         if self.shard_mapper is not None \
@@ -1487,7 +1876,25 @@ class FiloHttpServer:
             except (TypeError, ValueError):
                 deadline = None
         tr = self.tracer.start(tctx) if tctx is not None else None
-        with obs_trace.activate(tr):
+        # tenant QoS budget inheritance on the JSON leaf plane: forced
+        # charge (the entry node already made the admission decision)
+        # + the leg's priority class for the batcher
+        qctx = None
+        if body.get("tenant"):
+            qctx = qos.QosContext(tenant=str(body["tenant"]),
+                                  priority=int(body.get("priority")
+                                               or 0), forced=True)
+            adm = self.admission
+            if adm is not None and adm.budgets.enabled:
+                from filodb_tpu.parallel.cluster import wire_to_filters \
+                    as _w2f
+                adm.budgets.charge_forced(
+                    qctx.tenant, qos.estimate_leaf_cost(
+                        _w2f(body.get("filters", [])),
+                        self.shards_by_dataset.get(ds, ()),
+                        int(body.get("start_ms") or 0),
+                        int(body.get("end_ms") or 0)))
+        with qos.activate(qctx), obs_trace.activate(tr):
             with obs_trace.span("peer-fetch-raw",
                                 node=self.node_id or "", dataset=ds,
                                 plane="http"):

@@ -46,6 +46,13 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
     "TpuBackend._tile_lock",
     "TimeSeriesShard._odp_lock",
     "TimeSeriesPartition._cache_lock",
+    # tenant QoS (query/qos.py): the admission controller's gate
+    # counters sit above the budget map, which sits above individual
+    # bucket leaves (TenantBudgets.bucket() creates under the map lock;
+    # snapshot() reads bucket counters while iterating the map)
+    "AdmissionController._lock",
+    "TenantBudgets._lock",
+    "TokenBucket._lock",
     # leaves: short-hold counters, per-object state, channel caches
     "ShardMapper._lock",
     "CircuitBreaker._lock",

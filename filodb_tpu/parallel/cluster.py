@@ -33,6 +33,7 @@ import numpy as np
 from filodb_tpu.core.index import ColumnFilter
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.query import qos
 from filodb_tpu.parallel.resilience import (BreakerRegistry, Deadline,
                                             RetryPolicy, TransportError,
                                             resilient_call)
@@ -193,6 +194,14 @@ class RemoteShardGroup:
             "column": column, "shards": self.shard_nums,
             "full": bool(full),
         }
+        # tenant QoS: the fan-out leg inherits the entry query's tenant
+        # charge (the peer force-debits its own bucket for this tenant)
+        # and priority class (its batcher orders the leg accordingly)
+        qctx = qos.current()
+        if qctx is not None:
+            msg["tenant"] = qctx.tenant
+            if qctx.priority:
+                msg["priority"] = qctx.priority
 
         def dial(timeout_s: float) -> Dict:
             # server-side deadline propagation: the peer inherits the
@@ -293,6 +302,15 @@ class PromQlRemoteExec:
                     str(int(s)) for s in self.expect_shards)
         if self.no_cache:
             qs["cache"] = "false"
+        # tenant QoS: pushdown/federation hops name the tenant so the
+        # peer charges the same budget (forced on dispatch=local hops;
+        # a federation peer applies its own edge admission)
+        qctx = qos.current()
+        if qctx is not None:
+            qs["tenant"] = qctx.tenant
+            if qctx.priority:
+                qs["priority"] = qos.PRIORITY_NAMES.get(
+                    qctx.priority, "interactive")
         qs["hist-wire"] = "1"
 
         def dial(t: float) -> Dict:

@@ -44,15 +44,28 @@ batch, not the reverse.
 Failure semantics: an exception in a batched dispatch fails every
 member (they would all have taken the same kernel); callers surface it
 exactly as a single-query kernel failure.
+
+Priority classes (tenant QoS, query/qos.py): the executor's dispatch
+queue orders by the submitting query's priority class — interactive <
+rules/background < over-budget best-effort — so a brownout's monster
+scans never head-of-line block cheap interactive queries. A batch's
+class is the BEST (lowest) among its members at queue time: an
+interactive arrival joining an open best-effort batch rides that
+batch's already-queued position (PriorityQueue entries are immutable),
+but the common case — a best-effort leader queueing behind interactive
+leaders — reorders exactly as intended. On the CPU-inline path there
+is no queue to reorder; best-effort leaders instead yield the GIL a
+few extra rounds under concurrency so interactive threads pass them.
 """
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,6 +74,7 @@ from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.query import qos
 
 _QWAIT_HELP = ("Wall seconds a query spent parked on the micro-batcher "
                "(executor queueing + residual gather window); 0 for "
@@ -71,23 +85,32 @@ _OCC_HELP = "Members per micro-batch dispatch (batch occupancy)"
 class DeviceExecutor:
     """One dedicated thread owns device submission (the async-dispatch
     pipeline): HTTP worker threads enqueue batch closures and park on
-    futures instead of holding the GIL through device sync."""
+    futures instead of holding the GIL through device sync.
+
+    The queue orders by ``(priority, arrival)``: within a class it
+    stays FIFO, across classes a waiting interactive dispatch always
+    precedes a waiting best-effort one — the executor's busy time IS
+    the gather window, so under brownout queueing this is exactly
+    where head-of-line blocking would otherwise happen."""
 
     def __init__(self, name: str = "filodb-device-exec"):
-        self._q: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._q: "queue.PriorityQueue[Tuple[int, int, Optional[Callable[[], None]]]]" \
+            = queue.PriorityQueue()
+        self._seq = itertools.count()   # FIFO tiebreak within a class
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._started = False
         self._start_lock = threading.Lock()
 
-    def submit(self, fn: Callable[[], None]) -> None:
+    def submit(self, fn: Callable[[], None],
+               priority: int = qos.PRIORITY_INTERACTIVE) -> None:
         """Enqueue a closure for the executor thread (fire-and-forget:
         result delivery is the closure's business)."""
         with self._start_lock:
             if not self._started:
                 self._started = True
                 self._thread.start()
-        self._q.put(fn)
+        self._q.put((int(priority), next(self._seq), fn))
 
     def idle(self) -> bool:
         """True when nothing is queued (the executor may still be
@@ -97,7 +120,7 @@ class DeviceExecutor:
     @thread_root("device-executor")
     def _run(self) -> None:
         while True:
-            fn = self._q.get()
+            _prio, _seq, fn = self._q.get()
             if fn is None:
                 return
             try:
@@ -107,7 +130,9 @@ class DeviceExecutor:
 
     def stop(self) -> None:
         if self._started:
-            self._q.put(None)
+            # sorts behind every real priority class: queued work
+            # drains before the executor exits
+            self._q.put((1 << 30, next(self._seq), None))
 
 
 class SplitResult:
@@ -141,7 +166,7 @@ class SplitResult:
 
 @guarded_by("_lock", "batches", "queries", "batched_queries",
             "occupancy_sum", "occupancy_max", "gather_wait_ns",
-            "by_size")
+            "by_size", "by_priority")
 class BatchStats:
     """Occupancy/throughput counters surfaced in /metrics."""
 
@@ -154,8 +179,12 @@ class BatchStats:
         self.occupancy_max = 0
         self.gather_wait_ns = 0     # total residual gather-window time
         self.by_size: Dict[int, int] = {}
+        # dispatches per priority class (tenant QoS): operators read
+        # the brownout's best-effort share straight off /metrics
+        self.by_priority: Dict[int, int] = {}
 
-    def record(self, size: int, wait_ns: int) -> None:
+    def record(self, size: int, wait_ns: int,
+               priority: int = qos.PRIORITY_INTERACTIVE) -> None:
         with self._lock:
             self.batches += 1
             self.queries += size
@@ -165,6 +194,8 @@ class BatchStats:
             self.occupancy_max = max(self.occupancy_max, size)
             self.gather_wait_ns += wait_ns
             self.by_size[size] = self.by_size.get(size, 0) + 1
+            self.by_priority[priority] = \
+                self.by_priority.get(priority, 0) + size
         # occupancy distribution: p50/p95 batch sizes straight off a
         # /metrics scrape instead of the avg/max point gauges alone
         obs_metrics.observe("filodb_batcher_batch_size", _OCC_HELP,
@@ -180,20 +211,26 @@ class BatchStats:
                     "occupancy_max": self.occupancy_max,
                     "gather_wait_ms":
                         round(self.gather_wait_ns / 1e6, 3),
-                    "by_size": dict(self.by_size)}
+                    "by_size": dict(self.by_size),
+                    "by_priority": {
+                        qos.PRIORITY_NAMES.get(p, str(p)): n
+                        for p, n in self.by_priority.items()}}
 
 
 class _Pending:
     """One open batch: members join under the batcher lock until the
-    executor closes it; the result flows through one shared future."""
+    executor closes it; the result flows through one shared future.
+    ``priority`` is the best (lowest) class among members — set at
+    open, promoted by joins under the batcher lock."""
 
-    __slots__ = ("members", "future", "closed", "opened_ns")
+    __slots__ = ("members", "future", "closed", "opened_ns", "priority")
 
-    def __init__(self) -> None:
+    def __init__(self, priority: int = qos.PRIORITY_INTERACTIVE) -> None:
         self.members: List[object] = []
         self.future: Future = Future()
         self.closed = False
         self.opened_ns = time.perf_counter_ns()
+        self.priority = int(priority)
 
 
 @guarded_by("_lock", "_pending", "_active")
@@ -248,9 +285,10 @@ class MicroBatcher:
                ) -> np.ndarray:
         """Join (or open) the batch for ``key``; returns this member's
         split of the batch result."""
+        prio = qos.current_priority()
         if not self.enabled:
             res = run_batch([member])
-            self.stats.record(1, 0)
+            self.stats.record(1, 0, prio)
             obs_metrics.observe("filodb_batcher_queue_wait_seconds",
                                 _QWAIT_HELP, 0.0)
             return res.get(0)
@@ -261,8 +299,13 @@ class MicroBatcher:
                     and len(p.members) < self.max_batch:
                 idx = len(p.members)
                 p.members.append(member)
+                # a higher-class join promotes the OPEN batch's class
+                # (an already-queued entry keeps its position — the
+                # PriorityQueue entry is immutable; see module doc)
+                if prio < p.priority:
+                    p.priority = prio
             else:
-                p = _Pending()
+                p = _Pending(priority=prio)
                 p.members.append(member)
                 concurrent = self._active > 1
                 if concurrent:
@@ -280,17 +323,23 @@ class MicroBatcher:
             # keep joining until the executor picks it up (its busy
             # time is the gather window), then park on the future.
             # The trace context hops threads with the closure so device
-            # spans recorded on the executor land in the same trace.
+            # spans recorded on the executor land in the same trace;
+            # the executor queue orders by the batch's priority class.
             tctx = obs_trace.capture()
             self.executor.submit(
                 lambda: self._execute(key, p, run_batch, queued=True,
-                                      tctx=tctx))
+                                      tctx=tctx),
+                priority=p.priority)
             return self._wait(p, 0)
         # CPU: gather by yielding the GIL a few times (concurrent
         # same-shape submitters join during the yields; no fixed sleep
         # enters the latency path), then execute on THIS thread so the
-        # XLA-CPU compute of independent batches still uses all cores
-        for _ in range(3):
+        # XLA-CPU compute of independent batches still uses all cores.
+        # Best-effort work yields extra rounds under concurrency so
+        # interactive threads overtake it at the GIL (there is no
+        # dispatch queue to reorder on this path).
+        yields = 3 if prio < qos.PRIORITY_BEST_EFFORT else 12
+        for _ in range(yields):
             if len(p.members) >= self.max_batch:
                 break
             time.sleep(0)
@@ -335,12 +384,12 @@ class MicroBatcher:
             with obs_trace.use(tctx):
                 res = run_batch(members)
         except BaseException as e:  # noqa: BLE001 — fail all members
-            self.stats.record(len(members), wait_ns)
+            self.stats.record(len(members), wait_ns, p.priority)
             p.future.set_exception(e)
             if not queued:
                 raise
             return None
-        self.stats.record(len(members), wait_ns)
+        self.stats.record(len(members), wait_ns, p.priority)
         p.future.set_result(res)
         if queued:
             return None

@@ -636,6 +636,20 @@ class QueryPlanner:
             resilience = PeerResilience.default()
         self.resilience = resilience
         self.stats = QueryStats()
+        # tenant QoS (query/qos.py): the node's TenantMetering snapshot,
+        # when wired, prices remote shard groups in estimate_cost (local
+        # cardinality trackers only know local shards)
+        self.metering = None
+
+    def estimate_cost(self, plan):
+        """Pre-admission price of a plan over THIS planner's shard view
+        (query/qos.py): shard-key cardinality from the local trackers /
+        tag-index postings, the metering snapshot for fan-out groups,
+        grid step count and plan shape. The one facade both the HTTP
+        edge and the gRPC exec service charge budgets through."""
+        from filodb_tpu.query import qos
+        return qos.estimate_plan_cost(plan, self.shards,
+                                      metering=self.metering)
 
     def _remote_kw(self) -> Dict:
         """Resilience kwargs shared by every remote shard group."""

@@ -393,7 +393,8 @@ class RangeSession:
             "uncacheable", "stores", "evictions", "degraded_skips",
             "invalidations", "watermark_invalidations",
             "backfill_invalidations",
-            "cached_steps_served", "computed_steps_served")
+            "cached_steps_served", "computed_steps_served",
+            "stale_serves")
 class ResultCache:
     """Byte-accounted LRU of :class:`CachedExtent`, keyed
     ``(dataset, query, step, start % step, local_dispatch)``.
@@ -429,6 +430,7 @@ class ResultCache:
         self.backfill_invalidations = 0     # epoch-change drops
         self.cached_steps_served = 0
         self.computed_steps_served = 0
+        self.stale_serves = 0       # brownout rung: served past horizon
 
     @property
     def enabled(self) -> bool:
@@ -503,6 +505,49 @@ class ResultCache:
                   coverage=cov_n, extent=ext, cov=cov,
                   cached_steps=n_steps - computed,
                   computed_steps=computed)
+
+    def stale_serve(self, engine, dataset: str, query: str, plan,
+                    start_ms: int, step_ms: int, end_ms: int):
+        """Brownout rung (tenant QoS, query/qos.py): serve whatever
+        overlapping extent exists, PAST the freshness horizon — the
+        caller has decided a stale answer beats shedding the query.
+
+        Unlike :meth:`begin`, the hot window and watermark horizon are
+        ignored (stale is the point), but the correctness invalidators
+        still apply: a watermark REGRESSION, backfill-epoch change, or
+        coverage change means the extent may describe a world that
+        never existed — stale must never mean WRONG, so those extents
+        are dropped here exactly as on the normal path. The extent must
+        cover the request's first step (a head-missing stitch has no
+        cheap assembly); a short tail truncates and the caller stamps
+        the result partial. Returns a GridResult (``partial`` set on
+        truncation) or None; the result is never re-admitted — the
+        caller's shed warning trips the degraded-admission guard."""
+        if not self.enabled or step_ms <= 0 \
+                or not result_cacheable(plan):
+            return None
+        shards = getattr(engine, "shards", ())
+        key = range_abstracted_key(dataset, query, step_ms) \
+            + (int(start_ms) % int(step_ms),
+               bool(getattr(engine, "local_dispatch", False)))
+        ext = self._lookup(key, shards_watermark(shards),
+                           shards_epoch(shards),
+                           watermark_coverage(shards))
+        if ext is None:
+            return None
+        n_steps = (end_ms - start_ms) // step_ms + 1
+        grid_end = start_ms + (n_steps - 1) * step_ms
+        if ext.start_ms > start_ms or ext.end_ms < start_ms:
+            return None
+        hi = min(grid_end, ext.end_ms)
+        i0 = (start_ms - ext.start_ms) // ext.step_ms
+        i1 = (hi - ext.start_ms) // ext.step_ms + 1
+        steps = np.arange(start_ms, hi + 1, step_ms, dtype=np.int64)
+        grid = GridResult(steps, ext.keys, ext.values[:, i0:i1])
+        grid.partial = hi < grid_end
+        with self._lock:
+            self.stale_serves += 1
+        return grid
 
     def execute(self, engine, dataset: str, query: str, plan,
                 start_ms: int, step_ms: int, end_ms: int,
@@ -664,4 +709,5 @@ class ResultCache:
                     self.backfill_invalidations,
                 "cached_steps_served": self.cached_steps_served,
                 "computed_steps_served": self.computed_steps_served,
+                "stale_serves": self.stale_serves,
             }

@@ -858,7 +858,11 @@ class TpuBackend:
                 finally:
                     with self._tile_lock:
                         self._tile_refreshing.discard(key)
-            self.batcher.executor.submit(refresh)
+            # background class: a tile rebuild improves FUTURE queries
+            # and must never delay a queued interactive dispatch
+            from filodb_tpu.query import qos as _qos
+            self.batcher.executor.submit(
+                refresh, priority=_qos.PRIORITY_BACKGROUND)
             return stale
         entry = self._build_tile_entry(series, use_snap)
         self._insert_tile_entry(key, ident, entry)

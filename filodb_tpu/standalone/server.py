@@ -116,8 +116,26 @@ DEFAULTS = {
     # this knob; stream close / checkpoint sync() force the tail out.
     "stream-group-commit-ms": 5.0,
     # admission control: query endpoints admit at most this many
-    # in-flight evaluations (excess parks on a semaphore); 0 = off
+    # in-flight evaluations (excess parks on a semaphore); 0 = off.
+    # The wait is BOUNDED: a slot that does not free within
+    # admission-wait-s answers 429 + Retry-After (never a silent hang)
     "max-inflight-queries": 4,
+    "admission-wait-s": 5.0,
+    # -- tenant QoS / brownout control (query/qos.py) -----------------
+    # Per-tenant query budgets in estimated cost units/second (tenant =
+    # X-Filo-Tenant header / &tenant= param, by convention the
+    # workspace; "default" otherwise). 0 = budgets off (the pre-QoS
+    # edge). Burst is the bucket depth (0 = 10x rate); per-tenant
+    # overrides: {tenant: rate} or {tenant: [rate, burst]} (rate 0 =
+    # that tenant is unlimited). Over-budget queries degrade down the
+    # ladder (stale-cache -> downsample -> partial -> 429) unless
+    # qos-shed-degraded is false; the coarsen rung targets at most
+    # qos-degrade-max-steps evaluation steps.
+    "qos-tenant-rate": 0,
+    "qos-tenant-burst": 0,
+    "qos-tenant-overrides": {},
+    "qos-shed-degraded": True,
+    "qos-degrade-max-steps": 64,
     "peer-retry-attempts": 3,
     "peer-retry-base-delay-s": 0.05,
     "breaker-failure-threshold": 3,
@@ -276,6 +294,19 @@ class FiloServer:
         self.bus_client = None
         self._bus_tick_stop = threading.Event()
         self._bus_tick_thread: Optional[threading.Thread] = None
+
+    def _make_qos_budgets(self):
+        """Per-tenant token-bucket budgets from the qos-* knobs (None
+        semantics live in TenantBudgets.enabled: rate 0 and no
+        overrides = budgets off, the pre-QoS edge)."""
+        from filodb_tpu.query.qos import TenantBudgets
+        return TenantBudgets(
+            default_rate=float(self.config.get("qos-tenant-rate", 0)
+                               or 0),
+            default_burst=float(self.config.get("qos-tenant-burst", 0)
+                                or 0),
+            overrides=dict(self.config.get("qos-tenant-overrides")
+                           or {}))
 
     def _make_tracer(self):
         from filodb_tpu.obs.trace import Tracer
@@ -499,6 +530,13 @@ class FiloServer:
                 self.config.get("results-cache-hot-window-ms", 10_000)),
             max_inflight_queries=int(self.config.get(
                 "max-inflight-queries", 4)),
+            admission_wait_s=float(self.config.get(
+                "admission-wait-s", 5.0)),
+            qos_budgets=self._make_qos_budgets(),
+            qos_degrade_max_steps=int(self.config.get(
+                "qos-degrade-max-steps", 64)),
+            qos_shed_degraded=bool(self.config.get(
+                "qos-shed-degraded", True)),
             tracer=self._make_tracer(),
             slow_query_ms=float(self.config.get("slow-query-ms",
                                                 1000.0)),

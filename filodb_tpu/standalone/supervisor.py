@@ -81,7 +81,8 @@ from typing import Dict, List, Optional
 
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.lint.threads import thread_root
-from filodb_tpu.obs.metrics import ExpositionBuilder, merge_expositions
+from filodb_tpu.obs.metrics import (ExpositionBuilder, merge_expositions,
+                                    parse_exposition)
 from filodb_tpu.standalone.bus import SupervisorBus
 
 SUPERVISOR_DEFAULTS = {
@@ -158,7 +159,84 @@ def worker_config(base: Dict, ordinal: int, num_workers: int,
     cfg["max-inflight-queries"] = quotas[ordinal]
     cache_mb = float(base.get("results-cache-mb", 64) or 0)
     cfg["results-cache-mb"] = cache_mb / num_workers
+    # tenant QoS budgets are HOST bounds like admission: each worker
+    # gets 1/N of every refill rate and bucket depth, so an N-worker
+    # fleet charges the same aggregate budget per tenant as the
+    # single-process edge it replaces — not N x it. (Rates are floats;
+    # an even split loses nothing, unlike the slot split above.)
+    if base.get("qos-tenant-rate"):
+        cfg["qos-tenant-rate"] = \
+            float(base["qos-tenant-rate"]) / num_workers
+    if base.get("qos-tenant-burst"):
+        cfg["qos-tenant-burst"] = \
+            float(base["qos-tenant-burst"]) / num_workers
+    overrides = dict(base.get("qos-tenant-overrides") or {})
+    if overrides:
+        split_ov = {}
+        for tenant, ov in overrides.items():
+            if isinstance(ov, (list, tuple)):
+                split_ov[tenant] = [float(v) / num_workers for v in ov]
+            else:
+                split_ov[tenant] = float(ov) / num_workers
+        cfg["qos-tenant-overrides"] = split_ov
     return cfg
+
+
+# tenant families summed host-wide on the supervisor's /metrics: the
+# per-worker samples already flow through merge_expositions with a
+# worker label, but a tenant's shards (and its budget split) spread
+# ACROSS workers — the host-level sum is what an operator alerts on.
+# Gauges sum correctly here because each is an amount (series counts,
+# remaining budget units), not a ratio.
+_TENANT_SUM_FAMILIES = (
+    "filodb_tenant_time_series_total",
+    "filodb_tenant_time_series_active",
+    "filodb_tenant_budget_remaining",
+    "filodb_tenant_budget_rate",
+    "filodb_tenant_cost_charged_total",
+    "filodb_tenant_admitted_total",
+    "filodb_tenant_throttled_total",
+    "filodb_tenant_forced_charges_total",
+    "filodb_tenant_degraded_total",
+    "filodb_tenant_rejected_total",
+)
+
+
+def aggregate_tenant_families(by_worker: Dict[str, str]) -> str:
+    """Host-level per-tenant rollup of the workers' tenant cardinality
+    and budget families: same label sets, values summed across the
+    fleet, re-emitted as ``filodb_host_tenant_*`` (the per-worker view
+    keeps its ``worker`` label via merge_expositions; this is the
+    one-series-per-tenant view dashboards and alerts want)."""
+    sums: Dict[tuple, float] = {}
+    mtypes: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for body in by_worker.values():
+        for fam, mtype, name, labels, value in parse_exposition(
+                body, help_sink=helps):
+            if fam not in _TENANT_SUM_FAMILIES or name != fam:
+                continue
+            try:
+                v = float(value)
+            except ValueError:
+                continue
+            if mtype:       # keep the workers' declared type (the
+                mtypes[fam] = mtype  # cardinality gauges end in _total)
+            key = (fam, tuple(sorted(labels.items())))
+            sums[key] = sums.get(key, 0.0) + v
+    if not sums:
+        return ""
+    b = ExpositionBuilder()
+    for (fam, labels) in sorted(sums, key=str):
+        host_fam = fam.replace("filodb_tenant_", "filodb_host_tenant_")
+        v = sums[(fam, labels)]
+        b.sample(host_fam, dict(labels),
+                 int(v) if float(v).is_integer() else round(v, 3),
+                 mtype=mtypes.get(
+                     fam, "counter" if fam.endswith("_total")
+                     else "gauge"),
+                 help="Host-wide sum of %s across workers" % fam)
+    return b.render()
 
 
 class _Worker:
@@ -490,6 +568,10 @@ class Supervisor:
             if isinstance(body, str):
                 by_worker[str(w.ordinal)] = body
         out = merge_expositions(by_worker)
+        # host-wide per-tenant rollup (filodb_host_tenant_*): the
+        # per-worker tenant families above carry worker labels; this is
+        # the summed view a noisy-neighbor alert reads
+        out += aggregate_tenant_families(by_worker)
         b = ExpositionBuilder()
         with self._lock:
             snap = [(w.ordinal, w.proc, w.restarts)

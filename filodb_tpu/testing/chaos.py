@@ -26,6 +26,12 @@ Fault points wired in this build:
                         advertise ACTIVE (ctx: shard)
   * ``handoff.transfer`` — parallel/membership.py before each peer
                         ownership-transfer push (ctx: shard, node)
+  * ``qos.admit``     — http/server.py before the query-gate admission
+                        decision on every query endpoint hit
+                        (ctx: tenant, endpoint)
+  * ``qos.shed``      — http/server.py when an over-budget tenant
+                        enters the brownout degrade ladder, before any
+                        rung runs (ctx: tenant, query)
 
 Usage:
 

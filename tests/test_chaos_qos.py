@@ -1,0 +1,283 @@
+"""Chaos brownout scenario (tenant QoS, query/qos.py): sustained
+overload from one abusive tenant PLUS node loss, with the pinned
+acceptance semantics:
+
+  * interactive-tenant queries complete with ZERO failures — degraded
+    (partial) responses are allowed and counted, non-200s are not;
+  * the abusive tenant is throttled to its budget: its clean
+    admissions stop once the bucket drains, the rest of its traffic
+    gets degraded answers (each stamped with a ``shed(...)`` warning)
+    or 429 + Retry-After;
+  * after the load and the node loss end, responses are byte-identical
+    to the pre-load golden — no degraded/stale result ever poisoned a
+    cache.
+
+Chaos-config recipe (the documented brownout runbook shape, like the
+PR 8 crash runbook's): interactive clients send ``allow_partial=true``
+so a mid-loss fan-out degrades instead of failing; the failure
+detector polls too slowly to react, so the exec-layer resilience is
+what rides through the loss window — the same window
+tests/test_chaos_query.py pins without QoS."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+N_SAMPLES = 60
+N_INSTANCES = 4
+
+# the abuser's budget: refill 50 cost-units/s, burst 2000. The abusive
+# query shape below prices in the thousands, so the bucket drains
+# within the first couple of clean admissions and stays drained under
+# sustained load.
+ABUSE_RATE, ABUSE_BURST = 50, 2000
+
+INTERACTIVE_Q = dict(query='sum(rate(heap_usage[1m]))',
+                     start=T0 + 300, end=T0 + 400, step=20)
+ABUSE_Q = dict(query='rate({_metric_=~"heap_usage|http_requests_total"}'
+                     '[5m])',
+               start=T0 + 300, end=T0 + (N_SAMPLES - 1) * 10, step=10)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_raw(port, params, timeout=30):
+    url = (f"http://127.0.0.1:{port}/promql/timeseries/api/v1/"
+           f"query_range?" + urllib.parse.urlencode(params))
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _data_bytes(raw: bytes) -> bytes:
+    """Verbatim data section (exact float strings, exact series order);
+    the stats tail carries wall-clock timings and legitimately differs
+    — the same boundary every byte-identity golden in this repo uses."""
+    body, sep, _tail = raw.partition(b',"stats":')
+    assert sep, raw[:200]
+    return body
+
+
+def _scrape(port, name):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    out = {}
+    for ln in text.splitlines():
+        if ln.startswith(name):
+            series, _, val = ln.rpartition(" ")
+            out[series] = float(val)
+    return out
+
+
+@pytest.fixture
+def cluster():
+    """Two in-process nodes, half the shards each, QoS budgets on for
+    the abusive tenant only (everyone else is unbudgeted and must be
+    untouched by the brownout)."""
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "failure-detect-interval-s": 300.0,    # detection never reacts
+        "grpc-port": None,                     # deterministic HTTP plane
+        "results-cache-mb": 16,
+        "results-cache-hot-window-ms": 500.0,  # old data settles fast
+        "query-timeout-s": 8.0,
+        "max-inflight-queries": 16,
+        "admission-wait-s": 2.0,
+        "peer-retry-attempts": 1,
+        "peer-retry-base-delay-s": 0.01,
+        "breaker-failure-threshold": 1000,     # breakers stay closed
+        "qos-tenant-overrides": {"abuser": [ABUSE_RATE, ABUSE_BURST]},
+    }
+    a = FiloServer({**base, "node-ordinal": 0, "port": p0}).start()
+    a.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    b = FiloServer({**base, "node-ordinal": 1, "port": p1}).start()
+    b.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
+                    start_ms=T0 * 1000)
+    try:
+        yield a, b
+    finally:
+        chaos.uninstall()
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+
+def test_brownout_overload_plus_node_loss(cluster):
+    a, _b = cluster
+    # -- pre-load goldens (fresh, cache-off, healthy cluster) ----------
+    code, raw, _ = _get_raw(a.port, {**INTERACTIVE_Q, "cache": "false"})
+    assert code == 200
+    golden_interactive = _data_bytes(raw)
+    assert b'"partial"' not in golden_interactive
+    code, raw, _ = _get_raw(a.port, {**ABUSE_Q, "cache": "false"})
+    assert code == 200
+    golden_abuse = _data_bytes(raw)
+    # warm the cache once so the stale-serve rung has an extent
+    _get_raw(a.port, INTERACTIVE_Q)
+    _get_raw(a.port, ABUSE_Q)
+
+    stop = threading.Event()
+    interactive_results = []      # (code, partial, warnings)
+    abuse_results = []            # (code, warnings, retry_after)
+    errors = []
+
+    def interactive_loop():
+        # the documented brownout-recipe client: allow_partial so a
+        # mid-loss fan-out degrades instead of failing
+        params = {**INTERACTIVE_Q, "allow_partial": "true",
+                  "tenant": "interactive"}
+        while not stop.is_set():
+            try:
+                code, raw, _ = _get_raw(a.port, params)
+                body = json.loads(raw)
+                interactive_results.append(
+                    (code, bool(body.get("partial")),
+                     body.get("warnings") or []))
+            except Exception as e:   # noqa: BLE001 — recorded, asserted
+                errors.append(repr(e))
+            time.sleep(0.02)
+
+    def abuse_loop():
+        params = {**ABUSE_Q, "tenant": "abuser"}
+        while not stop.is_set():
+            try:
+                code, raw, hdrs = _get_raw(a.port, params)
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    body = {}
+                abuse_results.append(
+                    (code, body.get("warnings") or [],
+                     hdrs.get("Retry-After")))
+            except Exception as e:   # noqa: BLE001
+                errors.append("abuse:" + repr(e))
+
+    threads = [threading.Thread(target=interactive_loop, daemon=True)
+               for _ in range(2)]
+    threads += [threading.Thread(target=abuse_loop, daemon=True)]
+    for t in threads:
+        t.start()
+
+    # phase 1: pure overload (healthy cluster) ~1.2s
+    time.sleep(1.2)
+    # phase 2: node loss mid-overload — every peer call to node1 fails
+    # with the connection-refused shape while routing still points at it
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+    chaos.install(inj)
+    time.sleep(1.5)
+    chaos.uninstall()
+    # phase 3: recovered cluster, overload continues ~0.8s
+    time.sleep(0.8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    # -- zero interactive failures -------------------------------------
+    assert not errors, errors
+    assert interactive_results, "interactive load never ran"
+    non_200 = [r for r in interactive_results if r[0] != 200]
+    assert not non_200, f"interactive failures: {non_200[:5]}"
+    degraded = [r for r in interactive_results if r[1] or r[2]]
+    # during the loss window fan-outs to node1 degrade — allowed and
+    # counted, never failed
+    assert len(degraded) < len(interactive_results)
+
+    # -- the abusive tenant is throttled to its budget ----------------
+    assert abuse_results, "abuse load never ran"
+    shed = [r for r in abuse_results
+            if any("shed(" in w for w in r[1])]
+    rejected = [r for r in abuse_results if r[0] == 429]
+    assert shed or rejected, \
+        "abuser was never throttled: %r" % (abuse_results[:5],)
+    for code, _w, retry_after in rejected:
+        assert retry_after is not None      # 429 always names a backoff
+    # clean admissions are budget-bounded: total cost charged by
+    # try_charge can never exceed burst + rate x elapsed (forced
+    # charges are zero here — no entry hops carry tenant=abuser)
+    snap = a.http.admission.budgets.bucket("abuser").snapshot()
+    assert snap["throttled"] > 0
+    elapsed_budget = ABUSE_BURST + ABUSE_RATE * 10   # generous bound
+    assert snap["charged_total"] <= elapsed_budget
+
+    # -- byte-identical recovery --------------------------------------
+    code, raw, _ = _get_raw(a.port, {**INTERACTIVE_Q, "cache": "false"})
+    assert code == 200
+    assert _data_bytes(raw) == golden_interactive
+    code, raw, _ = _get_raw(a.port, {**ABUSE_Q, "cache": "false"})
+    assert code == 200
+    assert _data_bytes(raw) == golden_abuse
+    # the cache-warm path is also clean: degraded results were never
+    # admitted (stale serves read, they never write)
+    code, raw, _ = _get_raw(a.port, INTERACTIVE_Q)
+    assert code == 200
+    assert _data_bytes(raw) == golden_interactive
+
+
+def test_noisy_tenant_does_not_throttle_others(cluster):
+    """The selectivity pin, without chaos: after the abuser drains its
+    bucket, an unbudgeted tenant's identical query still executes
+    cleanly (no warnings, no partial, no 429)."""
+    a, _b = cluster
+    # drain: abuse queries until the first non-clean answer
+    for _ in range(10):
+        code, raw, _ = _get_raw(a.port, {**ABUSE_Q, "tenant": "abuser"})
+        body = json.loads(raw)
+        if code == 429 or body.get("warnings"):
+            break
+    else:
+        pytest.fail("abuser never throttled")
+    # the same query as another tenant: clean
+    code, raw, _ = _get_raw(a.port,
+                            {**ABUSE_Q, "cache": "false",
+                             "tenant": "friendly"})
+    body = json.loads(raw)
+    assert code == 200
+    assert not body.get("warnings") and not body.get("partial")
+    # and the abuser's shed is visible in /metrics
+    fams = _scrape(a.port, "filodb_tenant_throttled_total")
+    assert fams.get(
+        'filodb_tenant_throttled_total{tenant="abuser"}', 0) > 0
+
+
+def test_qos_chaos_fault_points(cluster):
+    """qos.admit / qos.shed fault points fire (testing/chaos.py): a
+    brownout test can inject latency or errors exactly at the
+    admission decision and the ladder entry."""
+    a, _b = cluster
+    inj = chaos.ChaosInjector()
+    with inj:
+        _get_raw(a.port, {**INTERACTIVE_Q, "tenant": "interactive"})
+        assert inj.fired("qos.admit") == 1
+        # over-budget entry: drain the abuser into the ladder
+        for _ in range(10):
+            code, raw, _ = _get_raw(a.port,
+                                    {**ABUSE_Q, "tenant": "abuser"})
+            if inj.fired("qos.shed"):
+                break
+        assert inj.fired("qos.shed") >= 1

@@ -311,3 +311,89 @@ def test_supervisor_object_start_stop_without_workers(tmp_path):
             in text.splitlines()
     finally:
         sup.stop(graceful=False)
+
+
+def test_worker_config_splits_qos_budgets():
+    """Tenant QoS budgets are HOST bounds like admission: each worker
+    gets 1/N of every refill rate / bucket depth, so the fleet charges
+    the same aggregate per-tenant budget as one process would."""
+    base = {"num-shards": 8, "qos-tenant-rate": 100.0,
+            "qos-tenant-burst": 1000.0,
+            "qos-tenant-overrides": {"abuser": 40.0,
+                                     "vip": [80.0, 400.0]}}
+    ports = [9001, 9002, 9003, 9004]
+    cfgs = [worker_config(base, i, 4, ports, 8080, 7000)
+            for i in range(4)]
+    assert sum(c["qos-tenant-rate"] for c in cfgs) == \
+        pytest.approx(100.0)
+    assert sum(c["qos-tenant-burst"] for c in cfgs) == \
+        pytest.approx(1000.0)
+    assert sum(c["qos-tenant-overrides"]["abuser"] for c in cfgs) == \
+        pytest.approx(40.0)
+    assert sum(c["qos-tenant-overrides"]["vip"][0] for c in cfgs) == \
+        pytest.approx(80.0)
+    assert sum(c["qos-tenant-overrides"]["vip"][1] for c in cfgs) == \
+        pytest.approx(400.0)
+    # budgets off: no keys are invented for the workers
+    cfg_off = worker_config({"num-shards": 8}, 0, 4, ports, 8080, 7000)
+    assert "qos-tenant-rate" not in cfg_off
+
+
+_TENANT_EXPO_W0 = """\
+# HELP filodb_tenant_time_series_total Per-tenant series count
+# TYPE filodb_tenant_time_series_total gauge
+filodb_tenant_time_series_total{_ws_="demo",_ns_="App-0"} 40
+# HELP filodb_tenant_budget_remaining Per-tenant token-bucket balance
+# TYPE filodb_tenant_budget_remaining gauge
+filodb_tenant_budget_remaining{tenant="abuser"} 25.0
+# HELP filodb_tenant_throttled_total Budget charges refused
+# TYPE filodb_tenant_throttled_total counter
+filodb_tenant_throttled_total{tenant="abuser"} 3
+"""
+
+_TENANT_EXPO_W1 = """\
+# HELP filodb_tenant_time_series_total Per-tenant series count
+# TYPE filodb_tenant_time_series_total gauge
+filodb_tenant_time_series_total{_ws_="demo",_ns_="App-0"} 24
+# HELP filodb_tenant_budget_remaining Per-tenant token-bucket balance
+# TYPE filodb_tenant_budget_remaining gauge
+filodb_tenant_budget_remaining{tenant="abuser"} -10.0
+# HELP filodb_tenant_throttled_total Budget charges refused
+# TYPE filodb_tenant_throttled_total counter
+filodb_tenant_throttled_total{tenant="abuser"} 5
+"""
+
+
+def test_merge_expositions_carries_tenant_families():
+    """The satellite pin: tenant cardinality/budget families flow
+    through the supervisor's merged /metrics with the worker label
+    injected like every other family."""
+    out = merge_expositions({"0": _TENANT_EXPO_W0,
+                             "1": _TENANT_EXPO_W1})
+    assert ('filodb_tenant_time_series_total'
+            '{_ns_="App-0",_ws_="demo",worker="0"} 40') in out
+    assert ('filodb_tenant_time_series_total'
+            '{_ns_="App-0",_ws_="demo",worker="1"} 24') in out
+    assert 'filodb_tenant_budget_remaining{tenant="abuser",worker="0"} 25.0' \
+        in out
+    assert 'filodb_tenant_throttled_total{tenant="abuser",worker="1"} 5' \
+        in out
+    # one HELP/TYPE block per family across the fleet
+    assert out.count("# TYPE filodb_tenant_time_series_total gauge") == 1
+
+
+def test_aggregate_tenant_families_host_rollup():
+    """filodb_host_tenant_*: per-tenant sums across workers — the
+    one-series-per-tenant view a noisy-neighbor alert reads (a
+    tenant's shards and its budget split spread ACROSS workers)."""
+    from filodb_tpu.standalone.supervisor import aggregate_tenant_families
+    out = aggregate_tenant_families({"0": _TENANT_EXPO_W0,
+                                     "1": _TENANT_EXPO_W1})
+    assert ('filodb_host_tenant_time_series_total'
+            '{_ns_="App-0",_ws_="demo"} 64') in out
+    assert 'filodb_host_tenant_budget_remaining{tenant="abuser"} 15' \
+        in out
+    assert 'filodb_host_tenant_throttled_total{tenant="abuser"} 8' in out
+    # non-tenant families are not rolled up
+    assert "filodb_host_tenant_time_series_total" in out
+    assert aggregate_tenant_families({}) == ""
