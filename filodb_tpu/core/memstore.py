@@ -36,6 +36,7 @@ import numpy as np
 
 from filodb_tpu.core.index import (END_TIME_INGESTING, ColumnFilter, TagIndex)
 from filodb_tpu.core.record import PartKey, RecordContainer
+from filodb_tpu.lint.caches import cache_registry, event_source, publishes
 from filodb_tpu.lint.locks import guarded_by, single_writer
 from filodb_tpu.core.schemas import (ColumnType, DataSchema, DatasetRef,
                                      Schemas)
@@ -71,7 +72,16 @@ def _is_hist(buf: bytes) -> bool:
 
 # the caches are shared by concurrent HTTP query threads; the chunk list
 # itself is append-only and read via snapshots, so only the caches (and
-# the publish step in switch_buffers) ride the lock
+# the publish step in switch_buffers) ride the lock.
+# Cache inventory: both caches validate against the chunk-set length at
+# read time (decoded prefix extends to len(chunks), a merge entry is
+# keyed by (n_chunks, tail_len)) — graftlint requires the read hooks to
+# keep consulting the chunk-set event source.
+@cache_registry("partition-decode", keyed=("column",),
+                validated_by={"chunk-set": ("read_full",
+                                            "hist_drop_rows")})
+@cache_registry("partition-merge", keyed=("column",),
+                validated_by={"chunk-set": ("read_full",)})
 @guarded_by("_cache_lock", "_decode_cache", "_merge_cache")
 class TimeSeriesPartition:
     """One time series in one shard (memstore/TimeSeriesPartition.scala:64).
@@ -222,6 +232,7 @@ class TimeSeriesPartition:
             return self.chunks[0].start_ts
         return int(self._ts_buf[0][0]) if self._buf_rows else None
 
+    @publishes("chunk-set")
     def switch_buffers(self) -> Optional[ChunkSetInfo]:
         """Encode the current write buffer into an immutable chunk
         (TimeSeriesPartition.scala:229 switchBuffers / :248 encodeOneChunkset).
@@ -298,6 +309,7 @@ class TimeSeriesPartition:
         with self._cache_lock:
             return self._decoded_chunk_arrays_locked(col_index, col)
 
+    @event_source("chunk-set")
     def _decoded_chunk_arrays_locked(self, col_index: int, col):
         """Body of _decoded_chunk_arrays; caller holds ``_cache_lock``.
 
@@ -604,6 +616,11 @@ class TimeSeriesShard:
         self.stats.num_series = len(self.partitions)
         return part
 
+    # the watermark/backfill-epoch mutation publishers: pull events —
+    # the results cache re-reads them via its @event_source functions
+    # on every lookup rather than being pushed to
+    @publishes("watermark")
+    @publishes("backfill-epoch")
     def ingest(self, container: RecordContainer, offset: int = -1) -> int:
         """Ingest one record container (TimeSeriesShard.scala:871).
         Returns number of rows ingested.
@@ -756,6 +773,7 @@ class TimeSeriesShard:
         return -1 if lo is None else lo
 
     # -- persistence / recovery -------------------------------------------
+    @publishes("watermark")
     def bootstrap_from_store(self) -> int:
         """Rebuild the tag index + partition shells from persisted partkeys
         and load checkpoint offsets (IndexBootstrapper.scala:43; recovery
@@ -907,6 +925,7 @@ class TimeSeriesShard:
             return 0
         return self.evict_partitions(cutoff_ts=cutoff)
 
+    @publishes("watermark")
     def evict_partitions(self, cutoff_ts: int) -> int:
         """Evict series whose data ended before cutoff
         (PartitionEvictionPolicy / EvictablePartIdQueueSet equivalents).

@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from filodb_tpu.http import prom_json
+from filodb_tpu.lint.caches import publishes
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
@@ -798,12 +799,77 @@ class FiloHttpServer:
         if out is not None:
             return out
         adm.budgets.record_rejected(qctx.tenant)
+        if cost > bucket.burst:
+            # the query prices above burst: it can NEVER charge cleanly
+            # no matter how long the client waits (burst IS the largest
+            # clean admission). The old `retry_after_s(cost)` capped at
+            # burst and read "Retry-After: 1" off a full bucket — a
+            # lie. Name the alternative that WOULD fit instead, or say
+            # explicitly that nothing does.
+            alt = self._never_admittable_alternative(
+                engine, plan, start, end, step, bucket.burst)
+            if alt is not None:
+                kind, alt_step, alt_cost = alt
+                hint = (f"retry with step>={alt_step}s (estimated "
+                        f"cost {alt_cost:.0f} fits the burst)"
+                        if kind == "coarsen" else
+                        f"retry the newest slice only (estimated "
+                        f"cost {alt_cost:.0f} fits the burst)")
+                raise qos.AdmissionRejected(
+                    f"tenant {qctx.tenant!r}: estimated cost "
+                    f"{cost:.0f} exceeds the budget's burst capacity "
+                    f"{bucket.burst:.0f} and can never admit cleanly; "
+                    f"{hint}",
+                    retry_after_s=bucket.retry_after_s(alt_cost),
+                    tenant=qctx.tenant, reason="never-admittable")
+            raise qos.AdmissionRejected(
+                f"tenant {qctx.tenant!r}: estimated cost {cost:.0f} "
+                f"exceeds the budget's burst capacity "
+                f"{bucket.burst:.0f} at every degraded resolution — "
+                f"never admittable under this tenant's budget; raise "
+                f"the budget or narrow the query",
+                retry_after_s=None,
+                tenant=qctx.tenant, reason="never-admittable")
         raise qos.AdmissionRejected(
             f"tenant {qctx.tenant!r} is over its query budget "
             f"(estimated cost {cost:.0f}) and no degraded answer "
             f"exists",
             retry_after_s=adm.budgets.retry_after_s(qctx.tenant, cost),
             tenant=qctx.tenant, reason="over-budget")
+
+    def _never_admittable_alternative(self, engine, plan, start: int,
+                                      end: int, step: int,
+                                      burst: float):
+        """A cheaper shape of the same query that CAN admit cleanly
+        under ``burst``, for the never-admittable 429 body:
+        ``("coarsen", step_s, cost)`` (preferred — the resolution the
+        degrade ladder would pick), ``("partial", step_s, cost)`` for
+        the newest-slice shape, or None when even those price above
+        burst."""
+        if step <= 0:
+            return None
+        from filodb_tpu.query.engine import lp_replace_range
+        coarse = qos.coarsen_step_s(start, step, end,
+                                    self.qos_degrade_max_steps)
+        try:
+            if coarse > step:
+                plan_b = lp_replace_range(plan, start * 1000,
+                                          coarse * 1000, end * 1000)
+                c = engine.estimate_cost(plan_b).total
+                if c <= burst:
+                    return ("coarsen", coarse, c)
+            n_steps = (end - start) // step + 1
+            if n_steps > 4:
+                keep = max(1, n_steps // 8)
+                start_c = start + (n_steps - keep) * step
+                plan_c = lp_replace_range(plan, start_c * 1000,
+                                          step * 1000, end * 1000)
+                c = engine.estimate_cost(plan_c).total
+                if c <= burst:
+                    return ("partial", step, c)
+        except Exception:   # noqa: BLE001 — a hint must never 500
+            return None
+        return None
 
     def _shed_degraded(self, engine, qs, ds: str, query: str, plan,
                        start: int, end: int, step: int,
@@ -858,26 +924,50 @@ class FiloHttpServer:
             # don't pay their plan walks either
             return None
         from filodb_tpu.query.engine import lp_replace_range
+
+        def run_rung(rung: str, plan_x, note: str,
+                     partial: bool = False):
+            """Charge + execute one compute rung. An EXECUTION failure
+            (a mid-loss fan-out leg, a transient query error) refunds
+            the rung's charge and falls through to the next rung /
+            terminal 429 — it must never surface as a 400: the client
+            sent a valid query, the degraded answer just wasn't
+            available. Deadline exhaustion keeps its own 503 shape."""
+            cost_x = engine.estimate_cost(plan_x).total
+            if not budgets.try_charge(tenant, cost_x):
+                return None
+            obs_trace.event("qos-shed", rung=rung, tenant=tenant)
+            try:
+                res = engine.materialize(plan_x).execute()
+            except (DeadlineExceeded, qos.AdmissionRejected):
+                raise
+            except Exception as e:     # noqa: BLE001 — fall to next rung
+                budgets.refund(tenant, cost_x)
+                obs_trace.event("qos-shed-failed", rung=rung,
+                                tenant=tenant, error=str(e)[:200])
+                return None
+            budgets.record_degraded(tenant, rung)
+            stages["qosShed"] = rung
+            if isinstance(res, GridResult):
+                res.partial = res.partial or partial
+                res.warnings.append(note)
+                return 200, self._encode_degraded(engine, res, qs)
+            if isinstance(res, ScalarResult):
+                return 200, prom_json.scalar(res, instant=False)
+            return None
+
         # rung 2: coarser resolution through the tiering path
         coarse = qos.coarsen_step_s(start, step, end,
                                     self.qos_degrade_max_steps)
         if coarse > step:
             plan_b = lp_replace_range(plan, start_ms, coarse * 1000,
                                       end_ms)
-            if budgets.try_charge(tenant,
-                                  engine.estimate_cost(plan_b).total):
-                budgets.record_degraded(tenant, "downsample")
-                obs_trace.event("qos-shed", rung="downsample",
-                                tenant=tenant)
-                res = engine.materialize(plan_b).execute()
-                stages["qosShed"] = "downsample"
-                if isinstance(res, GridResult):
-                    res.warnings.append(
-                        f"shed(downsample): tenant {tenant!r} over "
-                        f"budget; step coarsened {step}s -> {coarse}s")
-                    return 200, self._encode_degraded(engine, res, qs)
-                if isinstance(res, ScalarResult):
-                    return 200, prom_json.scalar(res, instant=False)
+            out = run_rung(
+                "downsample", plan_b,
+                f"shed(downsample): tenant {tenant!r} over budget; "
+                f"step coarsened {step}s -> {coarse}s")
+            if out is not None:
+                return out
         # rung 3: newest-slice partial
         n_steps = (end - start) // step + 1
         if n_steps > 4:
@@ -885,22 +975,13 @@ class FiloHttpServer:
             start_c = start + (n_steps - keep) * step
             plan_c = lp_replace_range(plan, start_c * 1000, step_ms,
                                       end_ms)
-            if budgets.try_charge(tenant,
-                                  engine.estimate_cost(plan_c).total):
-                budgets.record_degraded(tenant, "partial")
-                obs_trace.event("qos-shed", rung="partial",
-                                tenant=tenant)
-                res = engine.materialize(plan_c).execute()
-                stages["qosShed"] = "partial"
-                if isinstance(res, GridResult):
-                    res.partial = True
-                    res.warnings.append(
-                        f"shed(partial): tenant {tenant!r} over "
-                        f"budget; returned newest {keep}/{n_steps} "
-                        f"steps")
-                    return 200, self._encode_degraded(engine, res, qs)
-                if isinstance(res, ScalarResult):
-                    return 200, prom_json.scalar(res, instant=False)
+            out = run_rung(
+                "partial", plan_c,
+                f"shed(partial): tenant {tenant!r} over budget; "
+                f"returned newest {keep}/{n_steps} steps",
+                partial=True)
+            if out is not None:
+                return out
         return None
 
     def _shed_stale_saturated(self, ds: str, qs: Dict, qctx,
@@ -972,6 +1053,9 @@ class FiloHttpServer:
         prom_json.attach_degraded(out, res, engine.stats)
         return out
 
+    # dispatch-scope "publisher": scoped engines are born here (pull
+    # event — the results cache keys on dispatch_scope() per lookup)
+    @publishes("dispatch-scope")
     def make_planner(self, ds: str, local_dispatch: bool = False,
                      deadline: Optional[Deadline] = None,
                      allow_partial: bool = False,
@@ -1025,6 +1109,11 @@ class FiloHttpServer:
         planner.metering = self.tenant_metering
         return planner
 
+    # the schema mutation publisher (admin invalidate endpoint, bus
+    # broadcast, ops jobs): graftlint requires it to reach every
+    # registered cache's schema hook — plan cache directly, results
+    # cache through the plan cache's listener chain
+    @publishes("schema")
     def invalidate_plan_cache(self, reason: str = "schema") -> None:
         """Explicit plan-cache invalidation hook. Topology changes flow
         in automatically via ShardMapper events; callers that change a

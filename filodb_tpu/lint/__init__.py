@@ -31,6 +31,20 @@ Rule families (see the rule modules for the catalog):
     through call chains while a lock is held, and inference of shared
     state mutated from >=2 thread roots (``threads.thread_root``) with
     no common guard and no ``@guarded_by``.
+  * ``rules_spmd`` (v3) — SPMD/device dataflow over the entry-point
+    layer in ``dataflow.py``: collectives under divergent control flow
+    or with axis names absent from the enclosing mesh/spec
+    (``spmd-collective-balance``), use-after-donate / double-donate /
+    donate-of-live-state (``donation-safety``, advisory
+    ``donation-missing``), and PartitionSpec arity + axis-name
+    consistency (``partition-spec-consistency``).
+  * ``rules_cache`` (v3) — the cache inventory (``caches.py``):
+    every ``@publishes`` mutation publisher must reach every
+    registered cache's invalidation hook (through inferred
+    listener-registration bridges), every pull-validated lookup hook
+    must still read its ``@event_source``
+    (``cache-invalidation-completeness``); cache-looking classes
+    without a registry are ``cache-unregistered``.
 
 Mechanics:
 
@@ -256,9 +270,10 @@ def _load_rule_modules() -> None:
     if _rule_modules_loaded:
         return
     _rule_modules_loaded = True
-    from filodb_tpu.lint import (rules_concurrency,  # noqa: F401
-                                 rules_hot, rules_kernel, rules_lock,
-                                 rules_span, rules_trace)
+    from filodb_tpu.lint import (rules_cache,  # noqa: F401
+                                 rules_concurrency, rules_hot,
+                                 rules_kernel, rules_lock, rules_span,
+                                 rules_spmd, rules_trace)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
@@ -279,9 +294,11 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     whole-program but drops findings anchored outside those files —
     the ``--changed-only`` pre-commit mode."""
     _load_rule_modules()
-    from filodb_tpu.lint import (rules_concurrency, rules_hot,
-                                 rules_kernel, rules_lock, rules_span,
-                                 rules_trace)
+    from filodb_tpu.lint import (rules_cache, rules_concurrency,
+                                 rules_hot, rules_kernel, rules_lock,
+                                 rules_span, rules_spmd, rules_trace)
+    from filodb_tpu.lint import callgraph as _cgmod
+    from filodb_tpu.lint import dataflow as _dfmod
     root = package_root()
     if paths is None:
         paths = [os.path.join(root, "filodb_tpu")]
@@ -313,7 +330,15 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         for f in rules_lock.check_module(mod, lock_decls):
             raw.append((mod, f))
     bymod_path = {m.relpath: m for m in mods}
-    for relpath, f in rules_concurrency.check_project(mods):
+    # one call graph + one dataflow layer shared by every
+    # interprocedural family (concurrency, SPMD, cache completeness)
+    cg = _cgmod.build(mods)
+    df = _dfmod.DeviceDataflow(mods, cg)
+    for relpath, f in rules_concurrency.check_project(mods, cg=cg):
+        raw.append((bymod_path.get(relpath), f))
+    for relpath, f in rules_spmd.check_project(mods, cg=cg, df=df):
+        raw.append((bymod_path.get(relpath), f))
+    for relpath, f in rules_cache.check_project(mods, cg=cg, df=df):
         raw.append((bymod_path.get(relpath), f))
     if check_contracts:
         bymod = {m.relpath: m for m in mods}

@@ -43,8 +43,9 @@ def changed_files(base: Optional[str] = None) -> frozenset:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m filodb_tpu.lint",
-        description="graftlint: kernel-contract, trace-safety, and "
-                    "lock-discipline static analysis")
+        description="graftlint: kernel-contract, trace-safety, "
+                    "lock-discipline, SPMD/device-dataflow, and "
+                    "cache-invalidation static analysis")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "filodb_tpu package)")

@@ -888,6 +888,15 @@ class _BodyWalker:
             if ty:
                 return self.cg.resolve_method(ty, e.attr)
             return None
+        if isinstance(e, ast.Attribute) \
+                and isinstance(e.value, ast.Attribute) \
+                and isinstance(e.value.value, ast.Name):
+            # bound-method reference one attribute deeper:
+            # `self.result_cache.invalidate` as a callback argument
+            ty = self._expr_type(e.value)
+            if ty:
+                return self.cg.resolve_method(ty, e.attr)
+            return None
         if isinstance(e, ast.Name):
             return self._resolve_name_callee(e.id)
         return None

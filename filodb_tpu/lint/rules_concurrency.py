@@ -254,12 +254,15 @@ def _check_shared_state(cg: cgmod.CallGraph) -> Iterable[Finding]:
 
 # -- entry point -------------------------------------------------------------
 
-def check_project(mods: Sequence[ModuleSource]
+def check_project(mods: Sequence[ModuleSource],
+                  cg: Optional[cgmod.CallGraph] = None
                   ) -> List[Tuple[Optional[str], Finding]]:
     """Run all three families over the module set. Returns
     (relpath, finding) pairs so the runner can route pragma
-    suppression to the right file."""
-    cg = cgmod.build(mods)
+    suppression to the right file. ``cg`` lets the runner share one
+    call graph across the interprocedural families."""
+    if cg is None:
+        cg = cgmod.build(mods)
     out: List[Tuple[Optional[str], Finding]] = []
     for f in _check_lock_order(cg):
         out.append((f.path, f))

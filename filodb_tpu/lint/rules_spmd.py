@@ -1,0 +1,643 @@
+"""SPMD & device-dataflow rules (graftlint v3).
+
+Built on :mod:`filodb_tpu.lint.dataflow` (entry points, per-site
+closures, static-ness propagation). Multi-chip bugs are the worst class
+this repo will grow: an unbalanced collective hangs every host in the
+mesh with no stack trace, a donated-buffer read corrupts silently, and
+neither is catchable by a single-chip CPU test. Three error families
+plus one advisory:
+
+  * ``spmd-collective-balance`` — a collective (``psum`` /
+    ``all_gather`` / ``ppermute`` ...) inside a ``shard_map``-traced
+    closure sits under Python-level control flow that can diverge
+    across processes (a test reading ``process_index()`` / host
+    identity / RNG, or a value the static-ness propagation cannot prove
+    trace-static), or under a ``lax.cond``/``switch``/``while_loop``
+    branch (device-varying predicates execute different collective
+    sequences per device), or names a mesh axis that does not exist in
+    the enclosing mesh/spec environment. Any of these is a multi-host
+    deadlock or a silent partial-group reduction.
+  * ``donation-safety`` — a buffer donated via ``donate_argnums`` /
+    ``donate_argnames`` is read after the donating call, donated twice
+    along one path, or aliased by live shared state (an attribute /
+    container the donation invalidates behind the owner's back). The
+    refresh idiom ``self.buf = step(self.buf)`` — rebinding the same
+    state from the result in the same statement — is exempt.
+  * ``partition-spec-consistency`` — ``in_specs`` arity must match the
+    wrapped body's positional parameters, ``out_specs`` arity must
+    match the body's returned tuple, PartitionSpec entries must be
+    axis-name strings (or None), and every named axis must exist in the
+    constructing mesh (falling back to the module's, then the
+    project's, mesh-axis universe — so ``P("shards")`` against a
+    ``("shard", "time")`` mesh is caught at lint time, not as a
+    run-time KeyError on an 8-device pod).
+  * ``donation-missing`` (advisory, warning severity) — a jit-wrapped
+    callable invoked in a rebind loop (``x = step(x, ...)`` inside
+    ``for``/``while``) without donation: the tile-store refresh shape
+    ROADMAP 2 wants zero-copy. Advisory because donation is an API
+    contract change, not a local fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from filodb_tpu.lint import Finding, ModuleSource, register_rule
+from filodb_tpu.lint import callgraph as cgmod
+from filodb_tpu.lint import dataflow as dfmod
+
+register_rule("spmd-collective-balance", "spmd",
+              "collective under divergent control flow, lax.cond "
+              "branch, or with an axis name absent from the mesh/spec "
+              "environment")
+register_rule("donation-safety", "spmd",
+              "donated buffer read after the call, donated twice, or "
+              "aliased by live shared state")
+register_rule("partition-spec-consistency", "spmd",
+              "PartitionSpec arity/axis-name inconsistent with the "
+              "wrapped body or constructing mesh")
+register_rule("donation-missing", "spmd",
+              "jit callable re-binding its own argument in a loop "
+              "without donate_argnums (zero-copy refresh candidate)",
+              severity="warning")
+
+
+def _collective_axes(node: ast.Call) -> Tuple[str, ...]:
+    """Axis names named by a collective call (positional string args +
+    axis_name/axis kwarg, strings or tuples of strings)."""
+    out: List[str] = []
+
+    def harvest(e) -> None:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append(e.value)
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for el in e.elts:
+                harvest(el)
+
+    for a in node.args[1:]:
+        harvest(a)
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            harvest(kw.value)
+    return tuple(out)
+
+
+def _is_collective(node: ast.Call) -> bool:
+    leaf = dfmod._leaf(node.func)
+    if leaf not in dfmod.COLLECTIVE_LEAVES:
+        return False
+    # require a lax/jax base or a bare name (from-import) — keeps
+    # unrelated methods that happen to share a name out
+    if isinstance(node.func, ast.Attribute):
+        d = dfmod._dotted(node.func) or ""
+        return "lax" in d or d.startswith("jax")
+    return True
+
+
+def _own_nodes(fn_node) -> List[ast.AST]:
+    """Body nodes excluding nested function/lambda bodies (those are
+    their own closure members)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _test_divergence(test, dyn: Set[str]) -> Optional[str]:
+    """Why a control-flow test may diverge across hosts/devices, or
+    None when it is provably uniform-enough."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            leaf = dfmod._leaf(sub.func)
+            if leaf in dfmod._HOST_DIVERGENT_LEAVES:
+                return f"reads host-divergent {leaf}()"
+        if isinstance(sub, ast.Name) and sub.id in dyn:
+            return (f"branches on {sub.id!r}, which is not "
+                    f"trace-static")
+    return None
+
+
+class _DivergenceWalker:
+    """Find collective calls and the divergent control context they sit
+    under, within one function's own body."""
+
+    def __init__(self, dyn: Set[str]):
+        self.dyn = dyn
+        self.hits: List[Tuple[ast.Call, str]] = []      # (call, why)
+        self.clean: List[ast.Call] = []
+
+    def walk(self, fn_node) -> None:
+        body = fn_node.body if not isinstance(fn_node, ast.Lambda) \
+            else [ast.Expr(fn_node.body)]
+        for stmt in body:
+            self._walk(stmt, None)
+
+    def _walk(self, node, why: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        here = why
+        if isinstance(node, (ast.If, ast.While)):
+            d = _test_divergence(node.test, self.dyn)
+            if d is not None:
+                here = here or f"under a divergent if/while ({d})"
+        elif isinstance(node, ast.For):
+            d = _test_divergence(node.iter, self.dyn)
+            if d is not None:
+                here = here or f"under a loop whose bounds diverge ({d})"
+        if isinstance(node, ast.Call) and _is_collective(node):
+            if here is not None:
+                self.hits.append((node, here))
+            else:
+                self.clean.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, here)
+
+
+def _check_collectives(df: dfmod.DeviceDataflow
+                       ) -> List[Tuple[str, Finding]]:
+    out: List[Tuple[str, Finding]] = []
+    cg = df.cg
+    for key in sorted(df.spmd_reachable):
+        fi = cg.funcs.get(key)
+        if fi is None:
+            continue
+        env = df.axes_env.get(key, set())
+        dyn = df.dynamic_names(key)
+        w = _DivergenceWalker(dyn)
+        w.walk(fi.node)
+        for call, why in w.hits:
+            f = Finding(
+                rule="spmd-collective-balance", path=fi.relpath,
+                line=call.lineno,
+                message=(f"{fi.qualname}: collective "
+                         f"{dfmod._leaf(call.func)}() {why} inside a "
+                         f"shard_map-traced body — hosts/devices that "
+                         f"skip it deadlock the mesh"),
+                context=f"{fi.qualname}:divergent:"
+                        f"{dfmod._leaf(call.func)}")
+            out.append((fi.relpath, f))
+        for call in w.clean + [c for c, _ in w.hits]:
+            axes = _collective_axes(call)
+            missing = [a for a in axes if env and a not in env]
+            if missing:
+                f = Finding(
+                    rule="spmd-collective-balance", path=fi.relpath,
+                    line=call.lineno,
+                    message=(f"{fi.qualname}: collective "
+                             f"{dfmod._leaf(call.func)}() names axis "
+                             f"{missing[0]!r} which is absent from the "
+                             f"enclosing mesh/spec environment "
+                             f"({', '.join(sorted(env)) or 'empty'})"),
+                    context=f"{fi.qualname}:axis:{missing[0]}")
+                out.append((fi.relpath, f))
+        # lax.cond / switch / while_loop branches containing collectives
+        for node in _own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dfmod._leaf(node.func)
+            if leaf not in dfmod._STRUCTURED_CONTROL:
+                continue
+            d = dfmod._dotted(node.func) or ""
+            if "lax" not in d and not d.startswith("jax"):
+                continue
+            for ref in self_branch_refs(df, fi, node):
+                if _closure_has_collective(df, ref):
+                    rfi = cg.funcs[ref]
+                    f = Finding(
+                        rule="spmd-collective-balance", path=fi.relpath,
+                        line=node.lineno,
+                        message=(f"{fi.qualname}: lax.{leaf} branch "
+                                 f"{rfi.qualname} contains a "
+                                 f"collective — a device-varying "
+                                 f"predicate executes different "
+                                 f"collective sequences per device"),
+                        context=f"{fi.qualname}:branch:{rfi.qualname}")
+                    out.append((fi.relpath, f))
+                    break
+    return out
+
+
+def self_branch_refs(df: dfmod.DeviceDataflow, fi: cgmod.FuncInfo,
+                     node: ast.Call) -> List[str]:
+    """FuncInfo keys of branch/body functions handed to a lax control
+    primitive."""
+    out: List[str] = []
+    for a in node.args:
+        if isinstance(a, ast.Lambda):
+            k = df._lambda_by_line.get((fi.module, a.lineno))
+            if k:
+                out.append(k)
+        elif isinstance(a, ast.Name):
+            out.extend(df._body_keys_for(fi.module, a, fi))
+    return out
+
+
+def _closure_has_collective(df: dfmod.DeviceDataflow, key: str) -> bool:
+    for k in df.closure_of([key]):
+        fi = df.cg.funcs.get(k)
+        if fi is None:
+            continue
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Call) and _is_collective(node):
+                return True
+    return False
+
+
+# -- partition-spec consistency ----------------------------------------------
+
+
+def _module_imports_pspec(mod: ModuleSource) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "PartitionSpec":
+                    return True
+    return False
+
+
+def _return_arities(df: dfmod.DeviceDataflow, key: str) -> Set[int]:
+    fi = df.cg.funcs.get(key)
+    if fi is None or isinstance(fi.node, ast.Lambda):
+        if fi is not None and isinstance(fi.node.body, ast.Tuple):
+            return {len(fi.node.body.elts)}
+        return set()
+    out: Set[int] = set()
+    for node in _own_nodes(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Tuple):
+                out.add(len(node.value.elts))
+            elif isinstance(node.value, ast.Call):
+                return set()        # could return anything — unknown
+            else:
+                out.add(1)
+    return out
+
+
+def _check_specs(df: dfmod.DeviceDataflow, mods: Sequence[ModuleSource]
+                 ) -> List[Tuple[str, Finding]]:
+    out: List[Tuple[str, Finding]] = []
+    for site in df.sites:
+        if site.kind != "shard_map":
+            continue
+        allowed = set(site.mesh_axes or ()) \
+            or df.mesh.module_axes.get(site.module, set()) \
+            or df.mesh.project_axes
+        for spec in site.all_specs:
+            for bad in spec.bad_entries:
+                out.append((site.relpath, Finding(
+                    rule="partition-spec-consistency", path=site.relpath,
+                    line=spec.line or site.line,
+                    message=(f"PartitionSpec entry {bad} is neither an "
+                             f"axis-name string nor None"),
+                    context=f"spec:{site.relpath}:{bad}")))
+            if allowed:
+                for a in spec.axes:
+                    if a not in allowed:
+                        out.append((site.relpath, Finding(
+                            rule="partition-spec-consistency",
+                            path=site.relpath,
+                            line=spec.line or site.line,
+                            message=(f"PartitionSpec names axis {a!r} "
+                                     f"absent from the constructing "
+                                     f"mesh axes "
+                                     f"({', '.join(sorted(allowed))})"),
+                            context=f"spec-axis:{site.relpath}:{a}")))
+        if site.in_specs is not None \
+                and site.body_param_count is not None \
+                and len(site.in_specs) != site.body_param_count:
+            out.append((site.relpath, Finding(
+                rule="partition-spec-consistency", path=site.relpath,
+                line=site.line,
+                message=(f"in_specs declares {len(site.in_specs)} "
+                         f"specs but the shard_map body takes "
+                         f"{site.body_param_count} positional "
+                         f"arguments"),
+                context=f"in-arity:{site.relpath}:{site.line}")))
+        if site.out_specs is not None and site.out_specs_is_tuple \
+                and site.body_keys:
+            arities = _return_arities(df, site.body_keys[0])
+            if arities and all(a != len(site.out_specs)
+                               for a in arities):
+                got = ", ".join(str(a) for a in sorted(arities))
+                out.append((site.relpath, Finding(
+                    rule="partition-spec-consistency", path=site.relpath,
+                    line=site.line,
+                    message=(f"out_specs declares "
+                             f"{len(site.out_specs)} specs but the "
+                             f"body returns {got} value(s)"),
+                    context=f"out-arity:{site.relpath}:{site.line}")))
+    # free-floating P(...) literals (NamedSharding args, helper calls):
+    # axis typo check against the project mesh universe
+    site_lines = {(s.relpath, sp.line) for s in df.sites
+                  for sp in s.all_specs}
+    if df.mesh.project_axes:
+        for mod in mods:
+            if not _module_imports_pspec(mod):
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) \
+                        and dfmod._leaf(node.func) in ("P",
+                                                       "PartitionSpec"):
+                    if (mod.relpath, node.lineno) in site_lines:
+                        continue
+                    spec = dfmod.parse_spec(node)
+                    for a in spec.axes:
+                        if a not in df.mesh.project_axes:
+                            out.append((mod.relpath, Finding(
+                                rule="partition-spec-consistency",
+                                path=mod.relpath, line=node.lineno,
+                                message=(f"PartitionSpec names axis "
+                                         f"{a!r} which no mesh in the "
+                                         f"project declares (axes: "
+                                         f"{', '.join(sorted(df.mesh.project_axes))})"),
+                                context=f"spec-axis:{mod.relpath}:{a}")))
+    return out
+
+
+# -- donation safety ---------------------------------------------------------
+
+
+class _DonationScan:
+    """Ordered traversal of one function body checking reads of a
+    donated name after the donating call (rebinds clear the taint)."""
+
+    def __init__(self, call: ast.Call, name: str):
+        self.call = call
+        self.name = name
+        self.donated = False
+        self.read_at: Optional[int] = None
+
+    def run(self, fn_node) -> Optional[int]:
+        for stmt in fn_node.body:
+            self._visit(stmt)
+        return self.read_at
+
+    def _visit(self, node) -> None:
+        if self.read_at is not None:
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Assign):
+            self._visit(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == self.name:
+                    self.donated = False
+                else:
+                    self._visit(t)
+            return
+        if isinstance(node, ast.Name) and node.id == self.name \
+                and isinstance(node.ctx, ast.Load) and self.donated:
+            self.read_at = node.lineno
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+        if node is self.call:
+            self.donated = True
+
+
+def _donated_arg_exprs(call: ast.Call,
+                       site: dfmod.SpmdSite,
+                       body_params: Optional[List[str]]) -> List[ast.expr]:
+    out: List[ast.expr] = []
+    for i in site.donate_nums:
+        if 0 <= i < len(call.args):
+            out.append(call.args[i])
+    if site.donate_names and body_params:
+        for kw in call.keywords:
+            if kw.arg in site.donate_names:
+                out.append(kw.value)
+        for name in site.donate_names:
+            if name in body_params:
+                i = body_params.index(name)
+                if i < len(call.args):
+                    out.append(call.args[i])
+    return out
+
+
+def _attr_root_dotted(expr) -> Optional[str]:
+    """Dotted form of an attribute/subscript expression rooted at a
+    name (``self.buf``, ``obj.cache[k]`` -> ``obj.cache``)."""
+    e = expr
+    while isinstance(e, ast.Subscript):
+        e = e.value
+    return dfmod._dotted(e) if isinstance(e, ast.Attribute) else None
+
+
+def _check_donation(df: dfmod.DeviceDataflow,
+                    mods: Sequence[ModuleSource]
+                    ) -> List[Tuple[str, Finding]]:
+    out: List[Tuple[str, Finding]] = []
+    cg = df.cg
+    # donating callables bound to names: (module, name) -> site, plus
+    # decorator-form sites resolved through the call graph
+    bound: Dict[Tuple[str, str], dfmod.SpmdSite] = {}
+    plain_jit: Dict[Tuple[str, str], dfmod.SpmdSite] = {}
+    body_site: Dict[str, dfmod.SpmdSite] = {}
+    for site in df.sites:
+        if site.kind not in ("jit", "shard_map"):
+            continue
+        donating = bool(site.donate_nums or site.donate_names)
+        for bk in site.body_keys:
+            if donating:
+                body_site[bk] = site
+        if site.binding:
+            tgt = (site.module, site.binding)
+            if donating:
+                bound[tgt] = site
+            else:
+                plain_jit.setdefault(tgt, site)
+    for mod in mods:
+        dotted = cgmod.module_dotted(mod.relpath)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                kind = dfmod._wrapper_kind(node.value.func)
+                if kind is None:
+                    d = dfmod._dotted(node.value.func) or ""
+                    if d.rsplit(".", 1)[-1] == "partial" \
+                            and node.value.args:
+                        kind = dfmod._wrapper_kind(node.value.args[0])
+                if kind is None:
+                    continue
+                nums, names = dfmod._donate_from_kwargs(
+                    node.value.keywords)
+                t = node.targets[0]
+                name = None
+                if isinstance(t, ast.Name):
+                    name = t.id
+                elif isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    name = t.attr
+                if name is None:
+                    continue
+                site = dfmod.SpmdSite(
+                    kind=kind, module=dotted, relpath=mod.relpath,
+                    line=node.lineno, body_keys=(),
+                    donate_nums=nums, donate_names=names,
+                    binding=name)
+                if nums or names:
+                    bound[(dotted, name)] = site
+                else:
+                    plain_jit.setdefault((dotted, name), site)
+
+    def emit(fi, call, msg, ctx) -> None:
+        out.append((fi.relpath, Finding(
+            rule="donation-safety", path=fi.relpath, line=call.lineno,
+            message=f"{fi.qualname}: {msg}", context=ctx)))
+
+    for fi in cg.funcs.values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            site = self_donating_site(df, fi, node, bound, body_site)
+            if site is None:
+                continue
+            body_params = None
+            if site.body_keys:
+                bfi = cg.funcs.get(site.body_keys[0])
+                if bfi is not None \
+                        and not isinstance(bfi.node, ast.Lambda):
+                    body_params = [a.arg for a in bfi.node.args.args]
+            exprs = _donated_arg_exprs(node, site, body_params)
+            donated_ids = {id(e) for e in exprs}
+            other_names = {a.id for a in node.args
+                           if isinstance(a, ast.Name)
+                           and id(a) not in donated_ids}
+            other_names |= {kw.value.id for kw in node.keywords
+                            if isinstance(kw.value, ast.Name)
+                            and id(kw.value) not in donated_ids}
+            seen_names: Set[str] = set()
+            for e in exprs:
+                if isinstance(e, ast.Name):
+                    if e.id in seen_names:
+                        emit(fi, node,
+                             f"{e.id!r} is donated twice in one call — "
+                             f"the second donation reads freed memory",
+                             f"{fi.qualname}:double:{e.id}")
+                        continue
+                    if e.id in other_names:
+                        emit(fi, node,
+                             f"{e.id!r} is donated AND passed as a "
+                             f"second (non-donated) argument of the "
+                             f"same call — the alias reads the freed "
+                             f"buffer",
+                             f"{fi.qualname}:double:{e.id}")
+                        seen_names.add(e.id)
+                        continue
+                    seen_names.add(e.id)
+                    read = _DonationScan(node, e.id).run(fi.node)
+                    if read is not None:
+                        emit(fi, node,
+                             f"{e.id!r} is read at line {read} after "
+                             f"being donated here — donated buffers "
+                             f"are deallocated by the callee",
+                             f"{fi.qualname}:use-after:{e.id}")
+                    continue
+                root = _attr_root_dotted(e)
+                if root is not None:
+                    stmt_target = None
+                    # refresh idiom: same attribute rebound from result
+                    parent = getattr(e, "_filo_parent_stmt", None)
+                    if parent is None:
+                        parent = _enclosing_assign(fi.node, node)
+                    if parent is not None:
+                        for t in parent.targets:
+                            if dfmod._dotted(t) == root:
+                                stmt_target = root
+                    if stmt_target is None:
+                        emit(fi, node,
+                             f"donates {root!r}, which live state "
+                             f"still references — the cached/shared "
+                             f"buffer is deallocated behind its owner "
+                             f"(rebind it from the result in the same "
+                             f"statement, or donate a copy)",
+                             f"{fi.qualname}:aliased:{root}")
+    # advisory: rebind loops without donation
+    for fi in cg.funcs.values():
+        for loop in ast.walk(fi.node):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for stmt in ast.walk(loop):
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                tname = stmt.targets[0].id
+                call = stmt.value
+                if not any(isinstance(a, ast.Name) and a.id == tname
+                           for a in call.args):
+                    continue
+                key = None
+                if isinstance(call.func, ast.Name):
+                    key = (fi.module, call.func.id)
+                elif isinstance(call.func, ast.Attribute) \
+                        and isinstance(call.func.value, ast.Name) \
+                        and call.func.value.id == "self":
+                    key = (fi.module, call.func.attr)
+                if key is None or key not in plain_jit:
+                    continue
+                out.append((fi.relpath, Finding(
+                    rule="donation-missing", path=fi.relpath,
+                    line=stmt.lineno, severity="warning",
+                    message=(f"{fi.qualname}: {tname!r} is rebound "
+                             f"from a jit call that takes it as input "
+                             f"inside a loop — donate_argnums would "
+                             f"make the refresh zero-copy"),
+                    context=f"{fi.qualname}:missing:{tname}")))
+    return out
+
+
+def self_donating_site(df, fi, call: ast.Call, bound, body_site
+                       ) -> Optional[dfmod.SpmdSite]:
+    """The donating SpmdSite a call invokes, if any."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        site = bound.get((fi.module, f.id))
+        if site is not None:
+            return site
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        site = bound.get((fi.module, f.attr))
+        if site is not None:
+            return site
+    # decorator-form: callee resolves to a donating body
+    for s in fi.sites:
+        if s.line == call.lineno and s.kind == "call":
+            for c in s.callees:
+                if c in body_site:
+                    return body_site[c]
+    return None
+
+
+def _enclosing_assign(fn_node, call: ast.Call) -> Optional[ast.Assign]:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and node.value is call:
+            return node
+    return None
+
+
+# -- entry point -------------------------------------------------------------
+
+
+def check_project(mods: Sequence[ModuleSource],
+                  cg: Optional[cgmod.CallGraph] = None,
+                  df: Optional[dfmod.DeviceDataflow] = None
+                  ) -> List[Tuple[Optional[str], Finding]]:
+    if df is None:
+        df = dfmod.build(mods, cg)
+    out: List[Tuple[Optional[str], Finding]] = []
+    out.extend(_check_collectives(df))
+    out.extend(_check_specs(df, mods))
+    out.extend(_check_donation(df, mods))
+    return out

@@ -46,6 +46,7 @@ else:                                                   # jax <= 0.4.x
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_rep=check_vma)
 
+from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.query.model import RangeParams, RawSeries
 from filodb_tpu.query.tpu import (_GATHER_FUNCS, _TS_PAD, TpuBackend,
@@ -173,6 +174,11 @@ def _grouped_reduce(local: jnp.ndarray, gids: jnp.ndarray, num_groups: int,
     raise ValueError(f"unhandled mesh agg {agg}")
 
 
+# cache inventory: the cached_property executables (_step/_step_topk)
+# close over ONE mesh instance and specialize per static kernel shape —
+# world-independent by construction; a topology change builds a new
+# executor, never mutates this one
+@cache_registry("mesh-executable", keyed=("mesh", "kernel-shape"))
 class MeshExecutor:
     """Distributed query step executor over a ('shard','time') mesh.
 

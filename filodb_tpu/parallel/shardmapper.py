@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from filodb_tpu.core.record import ingestion_shard, query_shards
+from filodb_tpu.lint.caches import publishes
 from filodb_tpu.lint.locks import guarded_by
 
 
@@ -102,6 +103,12 @@ class ShardMapper:
         for cb in self._subscribers:
             cb(ev)
 
+    # the ONE topology-epoch mutation publisher: every ownership rewire
+    # funnels through here (membership handoff, crash reassignment, bus
+    # convergence, admin transfer). graftlint's cache-invalidation-
+    # completeness rule requires this function to reach every
+    # registered cache's topology hook through the subscription chain.
+    @publishes("topology-epoch")
     def update(self, shard: int, status: ShardStatus,
                node: Optional[str] = None, progress_pct: int = 0) -> None:
         # the transition (multi-field ShardState write + epoch bump) is

@@ -31,6 +31,7 @@ import threading
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
+from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.locks import guarded_by
 
 
@@ -48,6 +49,16 @@ def range_abstracted_key(dataset: str, query: str, step_ms: int) -> Tuple:
     return (dataset, query, int(step_ms))
 
 
+# inventory declaration (graftlint cache-invalidation-completeness):
+# parsed plans are topology- and schema-dependent ONLY — the evaluation
+# range is abstracted out of the key, so watermark/backfill events
+# cannot affect an entry. Every @publishes of these events must reach
+# `invalidate` through the call graph (the ShardMapper subscription and
+# the explicit schema hook), or the lint gate fails.
+@cache_registry("plan",
+                invalidated_by={"topology-epoch": "invalidate",
+                                "schema": "invalidate"},
+                keyed=("dataset", "query-text", "step"))
 @guarded_by("_lock", "_entries", "hits", "misses", "uncacheable",
             "invalidations", "rebases", "invalidations_by_reason")
 class PlanCache:

@@ -35,6 +35,7 @@ import numpy as np
 
 from filodb_tpu.core.index import ColumnFilter
 from filodb_tpu.core.record import shard_key_hash
+from filodb_tpu.lint.caches import publishes
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.engine import (METRIC_LABELS, QueryEngine,
                                      select_raw_series)
@@ -811,6 +812,11 @@ class QueryPlanner:
             local.append(grp)
         return local
 
+    # remote-group twin of the memstore's watermark/backfill publishers:
+    # gossip-stamped attributes the results cache reads through its
+    # @event_source functions exactly like local shard state
+    @publishes("watermark")
+    @publishes("backfill-epoch")
     def _stamp_peer_freshness(self, grp, node: str,
                               group: Sequence[int]) -> None:
         """Stamp a remote shard group with the peer's gossiped ingest

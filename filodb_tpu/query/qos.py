@@ -447,6 +447,14 @@ class TenantBudgets:
             return 0.0
         return b.retry_after_s(cost)
 
+    def refund(self, tenant: str, cost: float) -> None:
+        """Return a charge whose work never happened (a degrade-ladder
+        rung that failed mid-execution): the tenant must not pay for an
+        answer it never received."""
+        b = self.bucket(tenant)
+        if b is not None:
+            b.refund(cost)
+
     def record_degraded(self, tenant: str, rung: str) -> None:
         with self._lock:
             k = (tenant, rung)
@@ -481,12 +489,20 @@ class TenantBudgets:
 class AdmissionRejected(Exception):
     """Admission said no and no degraded answer exists: HTTP 429 with
     ``Retry-After`` (never the 503 deadline shape — a rejected query
-    was never executed)."""
+    was never executed).
 
-    def __init__(self, detail: str, retry_after_s: float = 1.0,
+    ``retry_after_s=None`` means NO amount of waiting can help (a
+    never-admittable query: its cost exceeds the tenant's burst at
+    every degraded resolution) — the edge then omits the Retry-After
+    header instead of emitting a misleading ``Retry-After: 1``, and
+    the detail string says what would actually admit."""
+
+    def __init__(self, detail: str,
+                 retry_after_s: Optional[float] = 1.0,
                  tenant: str = DEFAULT_TENANT, reason: str = ""):
         super().__init__(detail)
-        self.retry_after_s = max(0.0, float(retry_after_s))
+        self.retry_after_s = None if retry_after_s is None \
+            else max(0.0, float(retry_after_s))
         self.tenant = tenant
         self.reason = reason or "throttled"
 

@@ -67,6 +67,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from filodb_tpu.lint.caches import cache_registry, event_source
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
@@ -104,6 +105,18 @@ def result_cacheable(plan) -> bool:
     return not found[0]
 
 
+@event_source("dispatch-scope")
+def dispatch_scope(engine) -> bool:
+    """The engine's dispatch scope as a cache-key component: a
+    ``dispatch=local`` / gRPC ``local_only`` evaluation (the pushdown
+    loop-prevention hop) sees only this node's shards, so its extents
+    and a full fan-out query's extents must never serve each other
+    (the PR 5 review bug, now declared: graftlint requires the lookup
+    hooks to read this function)."""
+    return bool(getattr(engine, "local_dispatch", False))
+
+
+@event_source("watermark")
 def shards_watermark(shards: Sequence[object]) -> Optional[int]:
     """Freshness input: min ingest watermark over the engine's local
     shards that HAVE ingested, or None when none exposes one (pure
@@ -132,6 +145,7 @@ def shards_watermark(shards: Sequence[object]) -> Optional[int]:
     return int(min(wms))
 
 
+@event_source("watermark")
 def watermark_coverage(shards: Sequence[object]) -> int:
     """How many shards in the scope CONTRIBUTE a watermark (have
     ingested). Cached alongside the extent and checked on lookup: a
@@ -156,6 +170,7 @@ def watermark_coverage(shards: Sequence[object]) -> int:
     return total
 
 
+@event_source("backfill-epoch")
 def shards_epoch(shards: Sequence[object]) -> int:
     """Sum of the local shards' backfill epochs. A per-partition OOO
     guard cannot stop a NEW (or re-created/evicted-then-dropped) series
@@ -395,6 +410,24 @@ class RangeSession:
             "backfill_invalidations",
             "cached_steps_served", "computed_steps_served",
             "stale_serves")
+# inventory declaration (graftlint cache-invalidation-completeness):
+# topology/schema events PUSH through the plan-cache listener chain to
+# `invalidate`; watermark, backfill-epoch, and dispatch-scope are PULL
+# events — both serving entry points must keep reading their
+# @event_source functions (shards_watermark/watermark_coverage,
+# shards_epoch, dispatch_scope) or the lint gate fails. This is the
+# declaration that would have caught the PR 5 dispatch-scope key miss
+# and the PR 6 watermark-coverage hole at review time.
+@cache_registry("results",
+                invalidated_by={"topology-epoch": "invalidate",
+                                "schema": "invalidate"},
+                validated_by={"watermark": ("begin", "stale_serve"),
+                              "backfill-epoch": ("begin",
+                                                 "stale_serve"),
+                              "dispatch-scope": ("begin",
+                                                 "stale_serve")},
+                keyed=("dataset", "query-text", "step", "grid-phase",
+                       "dispatch-scope"))
 class ResultCache:
     """Byte-accounted LRU of :class:`CachedExtent`, keyed
     ``(dataset, query, step, start % step, local_dispatch)``.
@@ -469,8 +502,7 @@ class ResultCache:
         # prevention) evaluates a subset of the fan-out world — the two
         # must never share extents
         key = range_abstracted_key(dataset, query, step_ms) \
-            + (int(start_ms) % int(step_ms),
-               bool(getattr(engine, "local_dispatch", False)))
+            + (int(start_ms) % int(step_ms), dispatch_scope(engine))
         n_steps = (end_ms - start_ms) // step_ms + 1
         # the grid's LAST step — coverage and span math run on the step
         # grid, not the raw end (which need not be step-aligned)
@@ -528,8 +560,7 @@ class ResultCache:
             return None
         shards = getattr(engine, "shards", ())
         key = range_abstracted_key(dataset, query, step_ms) \
-            + (int(start_ms) % int(step_ms),
-               bool(getattr(engine, "local_dispatch", False)))
+            + (int(start_ms) % int(step_ms), dispatch_scope(engine))
         ext = self._lookup(key, shards_watermark(shards),
                            shards_epoch(shards),
                            watermark_coverage(shards))

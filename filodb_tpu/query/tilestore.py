@@ -1039,6 +1039,16 @@ def _f32_epilogue(func, counts, t1, v1, t2, v2, wstart_r, wend_r, wdur_s):
 
 _EVAL_T_JIT: Dict[Tuple, object] = {}
 
+# cache inventory (graftlint): the four module-level dispatch tables
+# (_EVAL_T_JIT/_EVAL_JIT and their vmapped twins) memoize compiled
+# executables keyed purely on (kernel family, func, pow2 shape bucket)
+# — a pure function of the request shape, immune to every world event
+# by construction, which is exactly what the declaration records.
+__cache_registry__ = {
+    "tilestore-executables": {"keyed": ("kernel", "func",
+                                        "shape-bucket")},
+}
+
 # executable-reuse observability: every dispatch-table lookup counts a
 # hit (compiled program reused) or a miss (new trace+compile). Shared
 # by the scalar and vmapped (micro-batched) dispatch families and

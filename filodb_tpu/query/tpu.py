@@ -41,6 +41,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.lint.threads import thread_root
@@ -523,6 +524,14 @@ class _PackedMember:
         self.w_bound = w_bound
 
 
+# cache inventory: the tile cache is immune to world events BY KEY —
+# snapshot keys embed (dataset, shard, part_id, num_chunks), so a flush
+# that publishes chunks changes the key instead of invalidating (the
+# stale-ident serve is coverage-bounded by cov_min_ms). The executable
+# set keys on pure kernel shape (world-independent by construction).
+@cache_registry("device-tile",
+                keyed=("selection-snapshot", "chunk-set"))
+@cache_registry("packed-executable", keyed=("kernel", "shape-bucket"))
 class TpuBackend:
     """Pluggable device backend for QueryEngine (the ``--exec-backend=tpu``
     boundary from BASELINE.json).

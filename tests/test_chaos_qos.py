@@ -108,7 +108,23 @@ def cluster():
         "peer-retry-attempts": 1,
         "peer-retry-base-delay-s": 0.01,
         "breaker-failure-threshold": 1000,     # breakers stay closed
-        "qos-tenant-overrides": {"abuser": [ABUSE_RATE, ABUSE_BURST]},
+        "qos-tenant-overrides": {
+            "abuser": [ABUSE_RATE, ABUSE_BURST],
+            # rung-failure regression: WIDE_Q prices ~140k, its coarse
+            # rung ~35k and partial rung ~16.5k — a 60k burst lets both
+            # ladder rungs charge (and then fail under chaos) while the
+            # full query stays over budget
+            "rungfail": [50, 60_000],
+            # never-admittable regression: every shape of a real query
+            # prices above this burst
+            "tinyburst": [1, 5],
+            # alternative-hint regression: the medium drain query
+            # (~22k) admits cleanly, after which the remaining ~16k
+            # cannot charge either rung (coarse ~35k, partial ~16.5k)
+            # while the coarse alternative still FITS the burst;
+            # near-zero refill keeps the drain in place
+            "althint": [0.001, 38_000],
+        },
     }
     a = FiloServer({**base, "node-ordinal": 0, "port": p0}).start()
     a.seed_dev_data(n_samples=N_SAMPLES, n_instances=N_INSTANCES,
@@ -281,3 +297,89 @@ def test_qos_chaos_fault_points(cluster):
             if inj.fired("qos.shed"):
                 break
         assert inj.fired("qos.shed") >= 1
+
+
+# a wide query: >64 steps so the coarsen rung applies, fanning out
+# across both nodes so a node-loss window can fail its execution
+WIDE_Q = dict(query='rate({_metric_=~"heap_usage|http_requests_total"}'
+                    '[5m])',
+              start=T0 + 300, end=T0 + 502, step=2)
+
+
+def test_shed_rung_failure_falls_through_to_429(cluster):
+    """ROADMAP 5 regression: a degrade-ladder rung whose EXECUTION
+    fails (here: rungs 2/3 fan out into a lost node) must fall through
+    to the next rung / terminal 429 — never surface as a 400 — and the
+    failed rung's charge is refunded."""
+    a, _b = cluster
+    params = {**WIDE_Q, "tenant": "rungfail", "cache": "false"}
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+    chaos.install(inj)
+    try:
+        code, raw, hdrs = _get_raw(a.port, params)
+    finally:
+        chaos.uninstall()
+    body = json.loads(raw)
+    assert code == 429, (code, raw[:300])
+    assert body.get("errorType") == "throttled", body
+    # both compute rungs charged, failed, and refunded: the bucket is
+    # back near its burst (minus only eventual-refill rounding), and
+    # the charges DID happen (the rungs executed, not skipped)
+    snap = a.http.admission.budgets.bucket("rungfail").snapshot()
+    assert snap["remaining"] >= 59_000, snap
+    assert snap["admitted"] >= 2, snap
+    # and with the cluster healthy again the same over-budget query
+    # gets a degraded 200 from the same ladder (the rung itself works)
+    code, raw, _ = _get_raw(a.port, params)
+    assert code == 200, raw[:300]
+    body = json.loads(raw)
+    assert any("shed(" in w for w in body.get("warnings") or []), body
+
+
+def test_never_admittable_full_bucket(cluster):
+    """ROADMAP 5 regression: a cost-above-burst query against a FULL
+    bucket used to answer a misleading `Retry-After: 1` (waiting can
+    never help — burst is the largest clean admission). It must now
+    carry an explicit never-admittable marker, and when no degraded
+    shape fits the burst either, omit Retry-After entirely."""
+    a, _b = cluster
+    code, raw, hdrs = _get_raw(
+        a.port, {**WIDE_Q, "tenant": "tinyburst", "cache": "false"})
+    body = json.loads(raw)
+    assert code == 429, (code, raw[:300])
+    assert "never admittable" in body.get("error", ""), body
+    assert "Retry-After" not in hdrs, hdrs
+    # the bucket was full the whole time: nothing charged
+    snap = a.http.admission.budgets.bucket("tinyburst").snapshot()
+    assert snap["remaining"] >= 4.5, snap
+
+
+def test_never_admittable_names_cheaper_alternative(cluster):
+    """When a degraded shape of the query WOULD fit the burst (but the
+    partially-drained bucket can't charge it right now), the 429 body
+    names that alternative and Retry-After reflects it — not the
+    impossible full-cost admission."""
+    a, _b = cluster
+    # drain partway: a medium query that admits cleanly
+    med = dict(query='sum(rate(heap_usage[5m]))',
+               start=T0 + 300, end=T0 + 500, step=2,
+               tenant="althint", cache="false")
+    code, raw, _ = _get_raw(a.port, med)
+    assert code == 200, raw[:300]
+    snap = a.http.admission.budgets.bucket("althint").snapshot()
+    assert snap["remaining"] < snap["burst"], snap
+    # the wide query prices above burst; its degraded shapes fit the
+    # burst but not the drained tokens -> rejection with the hint
+    code, raw, hdrs = _get_raw(
+        a.port, {**WIDE_Q, "tenant": "althint", "cache": "false"})
+    body = json.loads(raw)
+    if code == 200:
+        # the drain left enough tokens for a ladder rung — legitimate
+        # degraded answer; the regression target is only the 429 shape
+        assert any("shed(" in w for w in body.get("warnings") or [])
+        return
+    assert code == 429, (code, raw[:300])
+    assert "never admit" in body.get("error", ""), body
+    assert "fits the burst" in body.get("error", ""), body
+    assert hdrs.get("Retry-After") is not None, hdrs

@@ -1082,3 +1082,388 @@ def test_changed_only_cli_reports_nothing_when_tree_clean(tmp_path,
                    check_contracts=False, report_only=changed)
     assert [f.path for f in res.findings] == ["new.py"]
     assert res.findings[0].rule == "host-transfer-in-hot-loop"
+
+
+# -- graftlint v3: SPMD & device-dataflow families ---------------------------
+
+SPMD_DIVERGENT = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    if jax.process_index() == 0:
+        return jax.lax.psum(x, "shard")
+    return x
+"""
+
+SPMD_DIVERGENT_CLEAN = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.jit, static_argnames=("agg",))
+def run(x, agg):
+    # the mesh.py idiom: the shard_map body closes over the jit
+    # wrapper's STATIC parameter — branching on it is uniform across
+    # devices (one trace per static value)
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P("shard"),), out_specs=P())
+    def inner(x):
+        out = jax.lax.psum(x, "shard")     # unconditional: balanced
+        if agg == "mean":
+            out = out / jax.lax.psum(1.0, "shard")
+        return out
+    return inner(x)
+"""
+
+SPMD_DIVERGENT_PRAGMA = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    if jax.process_index() == 0:
+        # graftlint: disable=spmd-collective-balance (single-host test rig)
+        return jax.lax.psum(x, "shard")
+    return x
+"""
+
+
+def test_spmd_collective_divergent(tmp_path):
+    assert rules_of(lint_src(tmp_path, SPMD_DIVERGENT)) \
+        == ["spmd-collective-balance"]
+    assert not lint_src(tmp_path, SPMD_DIVERGENT_CLEAN).findings
+    res = lint_src(tmp_path, SPMD_DIVERGENT_PRAGMA)
+    assert not res.findings and res.suppressed == 1
+
+
+SPMD_BAD_AXIS = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("shard"),), out_specs=P())
+def f(x):
+    return jax.lax.psum(x, "shards")
+"""
+
+
+def test_spmd_collective_axis_mismatch(tmp_path):
+    res = lint_src(tmp_path, SPMD_BAD_AXIS)
+    assert rules_of(res) == ["spmd-collective-balance"]
+    assert "'shards'" in res.findings[0].message
+
+
+SPMD_COND_BRANCH = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+def then_branch(x):
+    return jax.lax.psum(x, "shard")
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    return jax.lax.cond(x.sum() > 0, then_branch, lambda v: v, x)
+"""
+
+
+def test_spmd_collective_in_cond_branch(tmp_path):
+    assert "spmd-collective-balance" in rules_of(
+        lint_src(tmp_path, SPMD_COND_BRANCH))
+
+
+DONATE_USE_AFTER = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+def advance(x, y):
+    out = step(x, y)
+    return out + x
+"""
+
+DONATE_CLEAN = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+def advance(x, y):
+    x = step(x, y)          # rebind: the donated name dies with the call
+    return x + y
+
+
+class Store:
+    def __init__(self):
+        self.tiles = None
+
+    def refresh(self, delta):
+        # the zero-copy refresh idiom: same state rebound from the result
+        self.tiles = step(self.tiles, delta)
+        return self.tiles
+"""
+
+DONATE_DOUBLE = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+def advance(x):
+    return step(x, x)
+"""
+
+DONATE_ALIASED = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+
+class Store:
+    def __init__(self):
+        self.tiles = None
+
+    def refresh(self, delta):
+        out = step(self.tiles, delta)   # donates live state, no rebind
+        return out
+"""
+
+DONATE_PRAGMA = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+def advance(x, y):
+    out = step(x, y)  # graftlint: disable=donation-safety (x provably dead: caller drops it)
+    return out + x
+"""
+
+
+def test_donation_safety(tmp_path):
+    assert rules_of(lint_src(tmp_path, DONATE_USE_AFTER)) \
+        == ["donation-safety"]
+    assert rules_of(lint_src(tmp_path, DONATE_DOUBLE)) \
+        == ["donation-safety"]
+    assert rules_of(lint_src(tmp_path, DONATE_ALIASED)) \
+        == ["donation-safety"]
+    assert not lint_src(tmp_path, DONATE_CLEAN).findings
+    res = lint_src(tmp_path, DONATE_PRAGMA)
+    assert not res.findings and res.suppressed == 1
+
+
+DONATE_MISSING = """
+import jax
+
+step = jax.jit(lambda a, b: a + b)
+
+def run(x, ys):
+    for y in ys:
+        x = step(x, y)
+    return x
+"""
+
+DONATE_MISSING_CLEAN = """
+import jax
+
+step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+def run(x, ys):
+    for y in ys:
+        x = step(x, y)
+    return x
+"""
+
+
+def test_donation_missing_advisory(tmp_path):
+    res = lint_src(tmp_path, DONATE_MISSING)
+    assert rules_of(res) == ["donation-missing"]
+    assert res.findings[0].severity == "warning"
+    assert not res.errors            # advisory: never fails the gate
+    assert not lint_src(tmp_path, DONATE_MISSING_CLEAN).findings
+
+
+SPEC_ARITY = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("shard"), P("shard")), out_specs=P())
+def f(x):
+    return x
+"""
+
+SPEC_BAD_MESH_AXIS = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("stime"),), out_specs=P())
+def f(x):
+    return x
+"""
+
+SPEC_OUT_ARITY = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=(P(), P()))
+def f(x):
+    return x + 1.0
+"""
+
+SPEC_CLEAN = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P("shard", None), P("shard")),
+                   out_specs=(P(None, "time"), P(None, "time")))
+def f(x, g):
+    return x, x * 2.0
+"""
+
+
+def test_partition_spec_consistency(tmp_path):
+    assert rules_of(lint_src(tmp_path, SPEC_ARITY)) \
+        == ["partition-spec-consistency"]
+    assert rules_of(lint_src(tmp_path, SPEC_BAD_MESH_AXIS)) \
+        == ["partition-spec-consistency"]
+    assert rules_of(lint_src(tmp_path, SPEC_OUT_ARITY)) \
+        == ["partition-spec-consistency"]
+    assert not lint_src(tmp_path, SPEC_CLEAN).findings
+
+
+# -- graftlint v3: cache-invalidation completeness ---------------------------
+
+CACHE_WIRED = """
+from filodb_tpu.lint.caches import cache_registry, event_source, publishes
+
+
+@cache_registry("plans", invalidated_by={"topology": "invalidate"},
+                validated_by={"epoch": ("lookup",)})
+class FixtureCache:
+    def __init__(self):
+        self._entries = {}
+
+    def invalidate(self, reason=""):
+        self._entries.clear()
+
+    def lookup(self, key, shards):
+        if read_epoch(shards) != 0:
+            return None
+        return self._entries.get(key)
+
+
+@event_source("epoch")
+def read_epoch(shards):
+    return sum(s.epoch for s in shards)
+
+
+class Mapper:
+    def __init__(self):
+        self._subs = []
+
+    def subscribe(self, cb):
+        self._subs.append(cb)
+
+    @publishes("topology")
+    def update(self, shard):
+        for cb in self._subs:
+            cb(shard)
+
+
+class Server:
+    def __init__(self, mapper: "Mapper"):
+        self.cache = FixtureCache()
+        mapper.subscribe(lambda ev: self.cache.invalidate("topology"))
+"""
+
+# same world, minus the subscription line: the publisher no longer
+# reaches the hook — the PR 5/6 class of bug, caught statically
+CACHE_UNWIRED = CACHE_WIRED.replace(
+    '        mapper.subscribe(lambda ev: self.cache.invalidate('
+    '"topology"))\n', "")
+
+# same world, but the lookup hook stopped consulting the epoch source
+CACHE_ROTTED_PULL = CACHE_WIRED.replace(
+    "        if read_epoch(shards) != 0:\n            return None\n",
+    "")
+
+
+def test_cache_completeness_wired_clean(tmp_path):
+    assert not lint_src(tmp_path, CACHE_WIRED).findings
+
+
+def test_cache_completeness_unwired_publisher(tmp_path):
+    res = lint_src(tmp_path, CACHE_UNWIRED)
+    assert rules_of(res) == ["cache-invalidation-completeness"]
+    assert "does not reach" in res.findings[0].message
+
+
+def test_cache_completeness_rotted_pull_hook(tmp_path):
+    res = lint_src(tmp_path, CACHE_ROTTED_PULL)
+    assert rules_of(res) == ["cache-invalidation-completeness"]
+    assert "never reads" in res.findings[0].message
+
+
+def test_cache_completeness_pragma(tmp_path):
+    # the finding anchors at the publisher's `def` line
+    src = CACHE_UNWIRED.replace(
+        "    def update(self, shard):",
+        "    def update(self, shard):"
+        "  # graftlint: disable=cache-invalidation-completeness"
+        " (wired at deploy time by the embedding app)")
+    res = lint_src(tmp_path, src)
+    assert not res.findings and res.suppressed == 1
+
+
+CACHE_UNREGISTERED = """
+class ShinyNewCache:
+    def __init__(self):
+        self._entries = {}
+"""
+
+CACHE_REGISTERED = """
+from filodb_tpu.lint.caches import cache_registry
+
+
+@cache_registry("shiny", keyed=("request-shape",))
+class ShinyNewCache:
+    def __init__(self):
+        self._entries = {}
+"""
+
+
+def test_cache_unregistered(tmp_path):
+    assert rules_of(lint_src(tmp_path, CACHE_UNREGISTERED)) \
+        == ["cache-unregistered"]
+    assert not lint_src(tmp_path, CACHE_REGISTERED).findings

@@ -1,8 +1,9 @@
 """Tier-1 gate: the real package lints clean against the shipped
-baseline — with the interprocedural concurrency families enabled at
-error severity — every pallas_call site carries a verified contract,
-the baseline itself is empty (nothing grandfathered), and a full run
-stays inside the pre-commit latency budget."""
+baseline — with the interprocedural concurrency families AND the v3
+SPMD/cache families enabled at error severity — every pallas_call site
+carries a verified contract, the baseline itself is empty (nothing
+grandfathered), and a full run stays inside the pre-commit latency
+budget."""
 
 import json
 import time
@@ -30,6 +31,21 @@ def test_concurrency_families_enabled_at_error():
                 "lock-blocking-reachable",
                 "thread-unguarded-shared-state"):
         assert cat[rid].severity == "error"
+
+
+def test_v3_families_enabled_at_error():
+    """The four graftlint v3 families ride the tier-1 gate at error
+    severity (donation-missing is the one deliberate advisory). The
+    perf guard above covers them: run_lint() builds the shared call
+    graph + dataflow layer with every v3 family enabled."""
+    from filodb_tpu.lint import rules
+    cat = rules()
+    for rid in ("spmd-collective-balance", "donation-safety",
+                "partition-spec-consistency",
+                "cache-invalidation-completeness",
+                "cache-unregistered"):
+        assert cat[rid].severity == "error"
+    assert cat["donation-missing"].severity == "warning"
 
 
 def test_shipped_baseline_is_empty():
