@@ -26,8 +26,10 @@ from typing import Dict, List, Optional, Tuple
 from filodb_tpu.http import prom_json
 from filodb_tpu.lint.caches import publishes
 from filodb_tpu.lint.threads import thread_root
+from filodb_tpu.obs import devprof as obs_devprof
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.obs.selfmon import SELFMON_DATASET
 from filodb_tpu.obs.slowlog import InflightRegistry, SlowQueryLog
 from filodb_tpu.obs.trace import Tracer
 from filodb_tpu.parallel.resilience import (Deadline, DeadlineExceeded,
@@ -181,6 +183,10 @@ class FiloHttpServer:
         # cardinality gauges; also the cost estimator's fan-out
         # cardinality view via make_planner)
         self.tenant_metering = None
+        # set by the standalone server under --self-monitor: the
+        # SelfMonitor loop (obs/selfmon.py) whose liveness gauges ride
+        # /metrics
+        self.selfmon = None
         # serving fast path: parsed-plan LRU (start/end abstracted out of
         # the key; dashboards re-issuing the same text skip parse+plan).
         # Invalidation: shard-topology events from the mapper, plus the
@@ -574,13 +580,21 @@ class FiloHttpServer:
             # convention the workspace), priority class from
             # &priority= / X-Filo-Priority. A dispatch=local hop is a
             # fan-out LEG: the entry node already made the admission
-            # decision, so the leg force-charges and never sheds.
+            # decision, so the leg force-charges and never sheds. The
+            # reserved __selfmon__ tenant (self-telemetry + the
+            # standing rules workload) likewise charges FORCED — its
+            # queries must not bounce off a drained bucket — and runs
+            # at the background class unless a priority was explicit.
+            tenant = (self._param(qs, "tenant") or tenant_hdr
+                      or qos.DEFAULT_TENANT)
+            raw_priority = self._param(qs, "priority") or priority_hdr
+            priority = qos.parse_priority(raw_priority)
+            selfmon_tenant = tenant == qos.SELFMON_TENANT
+            if selfmon_tenant and not raw_priority:
+                priority = qos.PRIORITY_BACKGROUND
             qctx = qos.QosContext(
-                tenant=(self._param(qs, "tenant") or tenant_hdr
-                        or qos.DEFAULT_TENANT),
-                priority=qos.parse_priority(
-                    self._param(qs, "priority") or priority_hdr),
-                forced=local_dispatch)
+                tenant=tenant, priority=priority,
+                forced=local_dispatch or selfmon_tenant)
             chaos.fire("qos.admit", tenant=qctx.tenant, endpoint=rest)
             adm = self.admission
             try:
@@ -1066,6 +1080,23 @@ class FiloHttpServer:
         shards = self.shards_by_dataset.get(ds)
         if shards is None:
             return None
+        if ds == SELFMON_DATASET:
+            # the reserved internal dataset is strictly node-local: its
+            # shard numbers are worker ordinals outside the user
+            # dataset's mapper world, every process serves only its own
+            # internal series (stamped with a worker label), and
+            # self-queries must never fan out, push down, or ride the
+            # mesh. A minimal planner over the local shard(s) keeps the
+            # whole cluster plane out of the loop — and out of its
+            # failure domain.
+            planner = QueryPlanner(
+                shards, backend=self.backend, deadline=deadline,
+                allow_partial=allow_partial,
+                no_result_cache=no_result_cache,
+                limits=self.query_limits, dataset=ds,
+                node_id=self.node_id)
+            planner.metering = self.tenant_metering
+            return planner
         peers = {} if local_dispatch else self.peers
         partitions = {} if local_dispatch else self.partitions
         grpc_peers = {} if local_dispatch else self.grpc_peers
@@ -1158,8 +1189,12 @@ class FiloHttpServer:
             raise QueryError("end < start")
         # tracing: a propagated context (peer hop) is always honored;
         # fresh requests sample per tracer policy; &explain=trace forces
-        # a trace for this one request and inlines it in the response
-        explain_trace = self._param(qs, "explain") == "trace"
+        # a trace for this one request and inlines it in the response;
+        # &explain=analyze extends it with per-stage device stats
+        # (executable identity + cost analysis, batcher occupancy,
+        # cache dispositions, shed decisions — obs/devprof.py)
+        explain = self._param(qs, "explain")
+        explain_trace = explain in ("trace", "analyze")
         tr = self.tracer.start(tctx, force=explain_trace)
         entry = self.inflight.register(
             query, ds, kind="range",
@@ -1182,6 +1217,9 @@ class FiloHttpServer:
                     self.tracer.finish(tr)
                     if explain_trace:
                         payload["trace"] = tr.to_json()
+                    if explain == "analyze":
+                        payload["analyze"] = self._build_analyze(
+                            tr, stages)
             elif tr is not None and tctx is None:
                 self.tracer.finish(tr)
             return code, payload
@@ -1320,7 +1358,8 @@ class FiloHttpServer:
         if not query:
             raise QueryError("missing query parameter")
         time_s = int(float(self._param(qs, "time", "0")))
-        explain_trace = self._param(qs, "explain") == "trace"
+        explain = self._param(qs, "explain")
+        explain_trace = explain in ("trace", "analyze")
         tr = self.tracer.start(tctx, force=explain_trace)
         entry = self.inflight.register(
             query, ds, kind="instant",
@@ -1340,6 +1379,9 @@ class FiloHttpServer:
                     self.tracer.finish(tr)
                     if explain_trace:
                         payload["trace"] = tr.to_json()
+                    if explain == "analyze":
+                        payload["analyze"] = self._build_analyze(
+                            tr, stages)
             elif tr is not None and tctx is None:
                 self.tracer.finish(tr)
             return code, payload
@@ -1387,6 +1429,37 @@ class FiloHttpServer:
             prom_json.attach_degraded(out, res, engine.stats)
         stages["encodeMs"] = round((_time.perf_counter() - t2) * 1000, 3)
         return 200, out
+
+    def _build_analyze(self, tr, stages: Dict) -> Dict:
+        """The ``&explain=analyze`` envelope: the traced spans resolve
+        to per-stage device stats — executable identity + compile
+        disposition per dispatch, cost-analysis FLOPs/bytes (computed
+        on demand, cached per executable), batcher occupancy at
+        dispatch, cache dispositions and shed decisions from the stage
+        breakdown."""
+        batcher_stats = None
+        batcher = getattr(self.backend, "batcher", None) \
+            if self.backend is not None else None
+        if batcher is not None:
+            bs = batcher.stats.snapshot()
+            batcher_stats = {"enabled": batcher.enabled,
+                             "occupancy_avg": bs["occupancy_avg"],
+                             "occupancy_max": bs["occupancy_max"],
+                             "batches": bs["batches"],
+                             "by_priority": bs["by_priority"]}
+        qctx = qos.current()
+        qos_info = None
+        if qctx is not None:
+            qos_info = {"tenant": qctx.tenant,
+                        "priority": qos.PRIORITY_NAMES.get(
+                            qctx.priority, str(qctx.priority)),
+                        "degraded": qctx.degraded,
+                        "forced": qctx.forced}
+            if stages.get("qosShed"):
+                qos_info["shed"] = stages["qosShed"]
+        return obs_devprof.analyze_payload(
+            tr.spans_json(), stages, batcher_stats=batcher_stats,
+            qos_info=qos_info)
 
     def _debug_traces(self, qs):
         """GET /debug/traces: recent finished traces (summaries), or one
@@ -1659,6 +1732,10 @@ class FiloHttpServer:
             "answer existed)",
         "filodb_batcher_priority_queries_total":
             "Batcher dispatches by priority class (tenant QoS)",
+        "filodb_selfmon_alive":
+            "1 while the self-monitoring loop thread is running",
+        "filodb_selfmon_interval_seconds":
+            "Configured self-monitoring collect+ingest interval",
         "filodb_traces_started_total": "Traces started on this node",
         "filodb_traces_stored": "Finished traces in /debug/traces",
         "filodb_slow_queries_total": "Queries over the slow-query "
@@ -1667,15 +1744,23 @@ class FiloHttpServer:
     }
 
     def _metrics_text(self) -> str:
+        return self.build_exposition().render()
+
+    def build_exposition(self) -> "obs_metrics.ExpositionBuilder":
         """Prometheus exposition — the Kamon-metrics surface
         (TimeSeriesShardStats, TimeSeriesShard.scala:41; MemoryStats;
         ChunkSourceStats; kamon prometheus reporter in
-        filodb-defaults.conf:1016), emitted through
+        filodb-defaults.conf:1016), accumulated into an
         :class:`~filodb_tpu.obs.metrics.ExpositionBuilder`: one
         ``# HELP``/``# TYPE`` block per family, consistent label-value
-        escaping, no duplicate series, and the obs histogram families
-        (query latency, batcher queue wait, device execute, flush,
-        ingest append/fsync) with ``_bucket``/``_sum``/``_count``."""
+        escaping, no duplicate series, and the global registry's
+        counter/gauge/histogram families + collectors (process stats,
+        device executable profiles).
+
+        Returning the BUILDER (``/metrics`` renders it; the
+        self-monitoring loop walks ``families()`` structurally) is the
+        registry-walk API: self-ingestion reads the same samples a
+        scrape would see, with no HTTP hop and no text parse."""
         import dataclasses as _dc
 
         b = obs_metrics.ExpositionBuilder()
@@ -1897,13 +1982,19 @@ class FiloHttpServer:
         emit("traces_stored", {}, ts["stored"])
         emit("slow_queries_total", {}, self.slow_log.snapshot()["recorded"])
         emit("inflight_queries", {}, len(self.inflight))
-        # stage-latency histograms (obs.metrics global registry):
-        # query latency, batcher queue wait / batch size, device
-        # execute, flush, ingest append + fsync
-        for h in sorted(obs_metrics.GLOBAL_REGISTRY.histograms(),
-                        key=lambda h: h.name):
-            b.histogram(h)
-        return b.render()
+        sm = getattr(self, "selfmon", None)
+        if sm is not None:
+            # loop-liveness gauges (the counters/age families ride the
+            # global registry and are collected below)
+            emit("selfmon_alive", {}, 1 if sm.alive else 0)
+            emit("selfmon_interval_seconds", {}, sm.interval_s)
+        # the global metric registry: counter/gauge families
+        # (self-monitor, executable builds), registered collectors
+        # (process stats, device-profiler cost gauges), then the
+        # stage-latency histograms — query latency, batcher queue wait /
+        # batch size, device execute, flush, ingest append + fsync
+        obs_metrics.GLOBAL_REGISTRY.collect_into(b)
+        return b
 
     def _cardinality(self, ds: str, qs: Dict, local: bool = False):
         """GET /api/v1/cardinality/{ds}?prefix=ws,ns&depth=N — per-prefix

@@ -58,6 +58,15 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
     "CircuitBreaker._lock",
     "BatchStats._lock",
     "SplitResult._lock",
+    # observability leaves: the self-monitor's tick counters, the
+    # device profiler's executable table (compiles run OUTSIDE it),
+    # and the metric registry's family maps (collect_into snapshots
+    # under the lock, samples outside)
+    "SelfMonitor._lock",
+    "DeviceProfiler._lock",
+    "MetricsRegistry._lock",
+    "CounterFamily._lock",
+    "GaugeFamily._lock",
     "GrpcQueryServer._rpc_lock",
     "LogIngestionStream._lock",
     "MemoryIngestionStream._lock",

@@ -24,6 +24,15 @@ HTTP/gRPC peer fan-out):
   * :mod:`filodb_tpu.obs.slowlog` — the slow-query log (structured
     records for queries over a threshold, with a per-stage breakdown)
     and the in-flight query registry behind ``/debug/queries``.
+  * :mod:`filodb_tpu.obs.devprof` — device compile/cost profiling:
+    per-executable build/recompile counters, XLA ``cost_analysis``
+    FLOPs/bytes, and the ``&explain=analyze`` payload.
+  * :mod:`filodb_tpu.obs.process` — host/process-level collector
+    (RSS, fds, threads, GC, uptime, build info).
+  * :mod:`filodb_tpu.obs.selfmon` — the self-monitoring loop: the
+    node ingests its own metrics into the reserved ``__selfmon__``
+    dataset through the normal ingest path and serves them over
+    PromQL.
 """
 
 from filodb_tpu.obs.metrics import (  # noqa: F401

@@ -102,15 +102,72 @@ class Histogram:
         return float(self.buckets[-1])
 
 
+@guarded_by("_lock", "_series")
+class CounterFamily:
+    """Labeled monotone counter family living in the registry (the
+    counter analogue of :class:`Histogram`): ``inc()`` from any thread,
+    ``series()`` snapshots for the exposition walk."""
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        # sorted (key, value) label tuple -> running total
+        self._series: Dict[Tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [(dict(k), v) for k, v in items]
+
+
+@guarded_by("_lock", "_series")
+class GaugeFamily:
+    """Labeled gauge family living in the registry (``set()`` replaces
+    the labeled series' value)."""
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._series[key] = float(value)
+
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [(dict(k), v) for k, v in items]
+
+
 class MetricsRegistry:
-    """Name-keyed histogram registry. One process-global instance
+    """Name-keyed metric-family registry. One process-global instance
     (:data:`GLOBAL_REGISTRY`) serves the deep layers (batcher, ingest
     stream, device dispatch) that have no natural path to the server
-    object; the /metrics endpoint exposes it."""
+    object; the /metrics endpoint exposes it.
+
+    Besides histograms it holds labeled counter/gauge families and
+    *collectors* — callables invoked at exposition-build time that
+    sample external state (the process collector reads /proc; the
+    device profiler walks its executable table). The registry is the
+    walkable surface the self-monitoring pipeline snapshots in-process
+    (obs/selfmon.py), so anything registered here is automatically a
+    PromQL-queryable series once ``--self-monitor`` is on."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._hists: Dict[str, Histogram] = {}
+        self._counters: Dict[str, CounterFamily] = {}
+        self._gauges: Dict[str, GaugeFamily] = {}
+        self._collectors: List = []
 
     def histogram(self, name: str, help: str,
                   buckets: Sequence[float] = LATENCY_BUCKETS_S
@@ -130,10 +187,62 @@ class MetricsRegistry:
         with self._lock:
             return list(self._hists.values())
 
+    def counter(self, name: str, help: str) -> CounterFamily:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = CounterFamily(name, help)
+                self._counters[name] = c
+            return c
+
+    def gauge(self, name: str, help: str) -> GaugeFamily:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = GaugeFamily(name, help)
+                self._gauges[name] = g
+            return g
+
+    def register_collector(self, fn) -> None:
+        """Register ``fn(builder: ExpositionBuilder)`` to be called at
+        every exposition build (idempotent by function identity)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def collect_into(self, builder: "ExpositionBuilder") -> None:
+        """Walk the whole registry into ``builder``: counter + gauge
+        families, registered collectors, then the histograms (sorted by
+        name, matching the /metrics layout)."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            collectors = list(self._collectors)
+            hists = list(self._hists.values())
+        for c in sorted(counters, key=lambda c: c.name):
+            for labels, v in c.series():
+                builder.sample(c.name, labels, _fmt_float(v),
+                               mtype="counter", help=c.help)
+        for g in sorted(gauges, key=lambda g: g.name):
+            for labels, v in g.series():
+                builder.sample(g.name, labels, _fmt_float(v),
+                               mtype="gauge", help=g.help)
+        for fn in collectors:
+            try:
+                fn(builder)
+            except Exception:   # noqa: BLE001 — a collector must never
+                pass            # fail the scrape
+        for h in sorted(hists, key=lambda h: h.name):
+            builder.histogram(h)
+
     def reset(self) -> None:
-        """Test hook: drop all registered histograms."""
+        """Test hook: drop all registered families. Collectors are
+        WIRING, not state — they survive a reset (the device profiler
+        and process collector register once per process)."""
         with self._lock:
             self._hists.clear()
+            self._counters.clear()
+            self._gauges.clear()
 
 
 GLOBAL_REGISTRY = MetricsRegistry()
@@ -234,6 +343,29 @@ class ExpositionBuilder:
         self.sample(h.name + "_count", labels, snap["count"],
                     family=h.name)
 
+    def families(self):
+        """Structured walk of the accumulated exposition — the in-process
+        alternative to rendering text and parsing it back (what the
+        self-monitoring pipeline does every tick). Yields
+        ``(family, mtype, help, samples)`` where each sample is
+        ``(sample_name, labels_tuple, value)``; ``labels_tuple`` is the
+        sorted ``((key, value), ...)`` form and duplicate series are
+        dropped exactly like :meth:`render` drops them (first writer
+        wins), so the walk and the text agree sample-for-sample."""
+        seen: set = set()
+        for fam in self._order:
+            mtype, help, samples = self._families[fam]
+            if not samples:
+                continue
+            out = []
+            for name, labels, value in samples:
+                key = (name, labels)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append((name, labels, value))
+            yield fam, mtype, help, out
+
     def render(self) -> str:
         lines: List[str] = []
         seen: set = set()
@@ -330,8 +462,80 @@ def merge_expositions(by_worker: "Dict[str, str]",
         for fam, mtype, name, labels, value in parsed[worker]:
             if not mtype:
                 mtype = "counter" if fam.endswith("_total") else "gauge"
-            b.sample(name, {**labels, "worker": str(worker)}, value,
-                     mtype=mtype,
+            # a sample that ALREADY carries a worker label keeps it:
+            # self-monitoring stamps internal series with their origin
+            # worker, and re-merging a merged exposition must be a
+            # no-op (merge idempotence — supervisor-of-supervisor
+            # chains and re-scraped aggregates stay stable)
+            lbl = dict(labels)
+            lbl.setdefault("worker", str(worker))
+            b.sample(name, lbl, value, mtype=mtype,
                      help=helps.get(fam, f"FiloDB metric {fam}"),
                      family=fam)
     return b.render()
+
+
+def validate_histogram_families(text: str) -> List[str]:
+    """Registry-wide histogram self-consistency validator over a full
+    text exposition. For every family declared ``histogram`` (per label
+    set, ``le`` excluded) it checks:
+
+      * bucket counts are cumulative (non-decreasing in ``le`` order),
+      * the ``+Inf`` bucket equals ``_count``,
+      * ``_sum`` and ``_count`` are both emitted.
+
+    Returns a list of human-readable violations (empty = clean). Run
+    as a tier-1 test over the live exposition AND by the supervisor
+    merge tests — a histogram that fails any of these breaks
+    ``histogram_quantile`` silently downstream."""
+    out: List[str] = []
+    # (family, labels-minus-le) -> {"buckets": [(le, v)], "count": v,
+    #                               "sum": present}
+    groups: Dict[Tuple, Dict] = {}
+    for fam, mtype, name, labels, value in parse_exposition(text):
+        if mtype != "histogram":
+            continue
+        base_labels = tuple(sorted((k, v) for k, v in labels.items()
+                                   if k != "le"))
+        g = groups.setdefault((fam, base_labels),
+                              {"buckets": [], "count": None,
+                               "sum": False})
+        try:
+            v = float(str(value).replace("+Inf", "inf"))
+        except ValueError:
+            out.append(f"{fam}{dict(base_labels)}: unparseable value "
+                       f"{value!r} on {name}")
+            continue
+        if name == fam + "_bucket":
+            try:
+                le = float(str(labels.get("le", "")).replace(
+                    "+Inf", "inf"))
+            except ValueError:
+                out.append(f"{fam}{dict(base_labels)}: bad le "
+                           f"{labels.get('le')!r}")
+                continue
+            g["buckets"].append((le, v))
+        elif name == fam + "_count":
+            g["count"] = v
+        elif name == fam + "_sum":
+            g["sum"] = True
+    for (fam, base_labels), g in sorted(groups.items(), key=str):
+        where = f"{fam}{dict(base_labels)}"
+        buckets = sorted(g["buckets"])
+        if not buckets:
+            out.append(f"{where}: histogram family with no _bucket "
+                       f"samples")
+            continue
+        vals = [v for _le, v in buckets]
+        if vals != sorted(vals):
+            out.append(f"{where}: bucket counts are not cumulative")
+        if buckets[-1][0] != math.inf:
+            out.append(f"{where}: no +Inf bucket")
+        if g["count"] is None:
+            out.append(f"{where}: _count not emitted")
+        elif buckets[-1][0] == math.inf and buckets[-1][1] != g["count"]:
+            out.append(f"{where}: +Inf bucket {buckets[-1][1]} != "
+                       f"_count {g['count']}")
+        if not g["sum"]:
+            out.append(f"{where}: _sum not emitted")
+    return out

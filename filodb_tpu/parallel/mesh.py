@@ -189,6 +189,10 @@ class MeshExecutor:
 
     def __init__(self, mesh: Optional[Mesh] = None):
         self.mesh = mesh if mesh is not None else make_mesh()
+        # first-sight keys for compile-event telemetry (one executor is
+        # driven by one query engine call at a time; races would only
+        # double-count a build event)
+        self._exec_seen: set = set()
 
     @functools.cached_property
     def _step(self):
@@ -357,6 +361,8 @@ class MeshExecutor:
          S) = self._prepare_inputs(series_by_shard, params, func,
                                    window_ms, group_ids_by_shard,
                                    offset_ms)
+        self._note_exec(("topk", func, int(k), bool(bottom), t_local,
+                         tuple(ts.shape)))
         out_v, out_i = self._step_topk(
             func, num_groups, int(k), bool(bottom), t_local,
             w_bound, ts, vals, lens, gids, w0s, w0e, step,
@@ -383,8 +389,21 @@ class MeshExecutor:
          _) = self._prepare_inputs(series_by_shard, params, func,
                                    window_ms, group_ids_by_shard,
                                    offset_ms)
+        self._note_exec(("agg", func, agg, t_local, tuple(ts.shape)))
         out = self._step(func, agg, num_groups,
                          t_local, w_bound, ts, vals, lens, gids,
                          w0s, w0e, step,
                          float(func_args[0]) if func_args else 0.0)
         return np.asarray(out)[:, :T]
+
+    def _note_exec(self, key) -> None:
+        """Compile/dispatch telemetry for the mesh-executable cache
+        (obs/devprof.py): per (kernel, static shape) key — first sight
+        is the shard_map trace + pjit compile, later dispatches reuse
+        the jit cache. Feeds filodb_executable_* families and the
+        &explain=analyze executable attribution."""
+        from filodb_tpu.obs import devprof
+        first = key not in self._exec_seen
+        if first:
+            self._exec_seen.add(key)
+        devprof.note_dispatch("mesh", key, first)

@@ -378,10 +378,18 @@ class MicroBatcher:
             if self._pending.get(key) is p:
                 del self._pending[key]
             members = list(p.members)
+            active = self._active
         try:
             # reinstall the submitting thread's trace context when this
             # runs on the executor thread (no-op for tctx=None/inline)
             with obs_trace.use(tctx):
+                # batcher occupancy at dispatch (&explain=analyze): how
+                # many members shared this device submission and how
+                # many query threads were concurrently inside the
+                # backend when it closed (no-op event when untraced)
+                obs_trace.event("batcher-dispatch", size=len(members),
+                                active=active, priority=p.priority,
+                                queued=queued)
                 res = run_batch(members)
         except BaseException as e:  # noqa: BLE001 — fail all members
             self.stats.record(len(members), wait_ns, p.priority)

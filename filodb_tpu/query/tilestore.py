@@ -1060,24 +1060,35 @@ _JIT_STATS_LOCK = _threading.Lock()
 __guarded_by__ = {"_JIT_STATS": "_JIT_STATS_LOCK"}
 
 
-def _jit_lookup(cache: Dict[Tuple, object], key: Tuple, build) -> object:
+def _jit_lookup(cache: Dict[Tuple, object], key: Tuple, build,
+                site: str = "tilestore", cost_args=None) -> object:
     """Dispatch-table lookup with hit/miss accounting; ``build()`` makes
     the jitted callable on a miss. Miss-side builds observe
     ``filodb_kernel_build_seconds`` — a retrace storm (shape-bucket
     churn, cache invalidation) shows up as histogram mass instead of
-    unexplained tail latency."""
+    unexplained tail latency.
+
+    Compile/cost profiling (obs/devprof.py): with ``cost_args`` (the
+    first call's argument tuple) the miss path lowers + compiles the
+    executable AOT — the one compile this miss was paying anyway —
+    captures XLA ``cost_analysis()`` FLOPs/bytes per executable, and
+    caches a :class:`~filodb_tpu.obs.devprof.ProfiledExecutable` whose
+    per-call accounting feeds the recompile counters and the
+    ``&explain=analyze`` executable attribution."""
     fn = cache.get(key)
     with _JIT_STATS_LOCK:
         _JIT_STATS["hits" if fn is not None else "misses"] += 1
     if fn is None:
+        from filodb_tpu.obs import devprof
         from filodb_tpu.obs import metrics as obs_metrics
         from filodb_tpu.obs import trace as obs_trace
         with obs_metrics.timed(
                 "filodb_kernel_build_seconds",
                 "Wall seconds per evaluator build on a dispatch-table "
                 "miss (trace + XLA compile)"), \
-                obs_trace.span("kernel-build"):
-            fn = build()
+                obs_trace.span("kernel-build", site=site):
+            fn = devprof.build_profiled(site, key, build,
+                                        cost_args=cost_args)
         cache[key] = fn
     return fn
 
@@ -1146,24 +1157,28 @@ def evaluate_counters_t(tiles: AlignedTiles, func: str, steps: np.ndarray,
         st, _, _ = el
         arrs = _tiles_arrays_slide(tiles, func, st)
         key = ("slide", func, nsteps, st)
+        args = (arrs, np.int64(tiles.num_slots),
+                np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+                np.int64(w0s), np.int64(w0e), np.int64(step))
         fn = _jit_lookup(_EVAL_T_JIT, key, lambda: jax.jit(
-            _functools.partial(_eval_counter_slide, func, nsteps, st)))
-        return fn(arrs, np.int64(tiles.num_slots),
-                  np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
-                  np.int64(w0s), np.int64(w0e), np.int64(step))
+            _functools.partial(_eval_counter_slide, func, nsteps, st)),
+            cost_args=args)
+        return fn(*args)
     if fits_i32:
         arrs = _tiles_arrays_fast(tiles, func)
-        fn = _jit_lookup(_EVAL_T_JIT, ("fast", func, nsteps),
-                         lambda: jax.jit(_functools.partial(
-                             _eval_counter_fast, func, nsteps)))
+        key = ("fast", func, nsteps)
+        build = lambda: jax.jit(_functools.partial(
+            _eval_counter_fast, func, nsteps))
     else:
         arrs = _tiles_arrays_t(tiles, func)
-        fn = _jit_lookup(_EVAL_T_JIT, ("t", func, nsteps),
-                         lambda: jax.jit(_functools.partial(
-                             _eval_counter_t, func, nsteps)))
-    return fn(arrs, np.int64(tiles.num_slots),
-              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
-              np.int64(w0s), np.int64(w0e), np.int64(step))
+        key = ("t", func, nsteps)
+        build = lambda: jax.jit(_functools.partial(
+            _eval_counter_t, func, nsteps))
+    args = (arrs, np.int64(tiles.num_slots),
+            np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+            np.int64(w0s), np.int64(w0e), np.int64(step))
+    fn = _jit_lookup(_EVAL_T_JIT, key, build, cost_args=args)
+    return fn(*args)
 
 
 @kernel_contract(
@@ -1280,11 +1295,12 @@ def evaluate_aligned(tiles: AlignedTiles, func: str, steps: np.ndarray,
     w0s = np.int64(w0e - window_ms)
     step = np.int64(steps[1] - steps[0]) if nsteps > 1 else np.int64(1)
     arrs = _tiles_arrays(tiles, func)
+    args = (arrs, np.int64(tiles.num_slots),
+            np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+            np.int64(w0s), np.int64(w0e), np.int64(step))
     fn = _jit_lookup(_EVAL_JIT, (func, nsteps), lambda: jax.jit(
-        _functools.partial(_eval_core, func, nsteps)))
-    return fn(arrs, np.int64(tiles.num_slots),
-              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
-              np.int64(w0s), np.int64(w0e), np.int64(step))
+        _functools.partial(_eval_core, func, nsteps)), cost_args=args)
+    return fn(*args)
 
 
 # ---------------------------------------------------------------------------
@@ -1356,28 +1372,28 @@ def evaluate_counters_t_batch(tiles: AlignedTiles, func: str,
     if kind == "slide":
         st = family[1]
         arrs = _tiles_arrays_slide(tiles, func, st)
-        fn = _jit_lookup(_EVAL_T_VMAP, ("slide", func, nsteps, st, b_pad),
-                         lambda: jax.jit(jax.vmap(
-                             _functools.partial(_eval_counter_slide, func,
-                                                nsteps, st),
-                             in_axes=_GRID_AXES)))
+        key = ("slide", func, nsteps, st, b_pad)
+        build = lambda: jax.jit(jax.vmap(
+            _functools.partial(_eval_counter_slide, func, nsteps, st),
+            in_axes=_GRID_AXES))
     elif kind == "fast":
         arrs = _tiles_arrays_fast(tiles, func)
-        fn = _jit_lookup(_EVAL_T_VMAP, ("fast", func, nsteps, b_pad),
-                         lambda: jax.jit(jax.vmap(
-                             _functools.partial(_eval_counter_fast, func,
-                                                nsteps),
-                             in_axes=_GRID_AXES)))
+        key = ("fast", func, nsteps, b_pad)
+        build = lambda: jax.jit(jax.vmap(
+            _functools.partial(_eval_counter_fast, func, nsteps),
+            in_axes=_GRID_AXES))
     else:
         arrs = _tiles_arrays_t(tiles, func)
-        fn = _jit_lookup(_EVAL_T_VMAP, ("t", func, nsteps, b_pad),
-                         lambda: jax.jit(jax.vmap(
-                             _functools.partial(_eval_counter_t, func,
-                                                nsteps),
-                             in_axes=_GRID_AXES)))
-    return fn(arrs, np.int64(tiles.num_slots),
-              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
-              w0s_v, w0e_v, np.int64(step))
+        key = ("t", func, nsteps, b_pad)
+        build = lambda: jax.jit(jax.vmap(
+            _functools.partial(_eval_counter_t, func, nsteps),
+            in_axes=_GRID_AXES))
+    args = (arrs, np.int64(tiles.num_slots),
+            np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+            w0s_v, w0e_v, np.int64(step))
+    fn = _jit_lookup(_EVAL_T_VMAP, key, build,
+                     site="tilestore-batch", cost_args=args)
+    return fn(*args)
 
 
 def evaluate_aligned_batch(tiles: AlignedTiles, func: str, nsteps: int,
@@ -1389,10 +1405,12 @@ def evaluate_aligned_batch(tiles: AlignedTiles, func: str, nsteps: int,
     w0e_v = jnp.asarray(_pad_pow2(list(w0e_list)))
     b_pad = int(w0s_v.shape[0])
     arrs = _tiles_arrays(tiles, func)
+    args = (arrs, np.int64(tiles.num_slots),
+            np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
+            w0s_v, w0e_v, np.int64(step))
     fn = _jit_lookup(_EVAL_VMAP, (func, nsteps, b_pad),
                      lambda: jax.jit(jax.vmap(
                          _functools.partial(_eval_core, func, nsteps),
-                         in_axes=_GRID_AXES)))
-    return fn(arrs, np.int64(tiles.num_slots),
-              np.int64(tiles.base_ms), np.int64(tiles.dt_ms),
-              w0s_v, w0e_v, np.int64(step))
+                         in_axes=_GRID_AXES)),
+                     site="tilestore-batch", cost_args=args)
+    return fn(*args)

@@ -45,6 +45,7 @@ from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.lint.threads import thread_root
+from filodb_tpu.obs import devprof
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.query.model import GridResult, RangeParams, RawSeries
@@ -135,6 +136,24 @@ def pack_series(series: Sequence[RawSeries], drop_nan: bool = True
         vals_pad[i, :n] = vals
         lens[i] = n
     return ts_pad, vals_pad, lens
+
+
+def _abstract(a):
+    """Array -> ShapeDtypeStruct for lazy cost probes (0-d scalars and
+    plain Python values stay concrete — tiny, and statics must be)."""
+    if getattr(a, "ndim", 0) > 0:
+        return _sds(tuple(a.shape), a.dtype)
+    return a
+
+
+def _lower_probe(jfn, *largs):
+    """() -> Compiled over an abstract call signature: the on-demand
+    cost-analysis probe for kernels that compile inside their own
+    ``jax.jit`` cache (we cannot reach that executable, so analyze
+    pays one equivalent compile per executable, once)."""
+    def probe():
+        return jfn.lower(*largs).compile()
+    return probe
 
 
 def _pad_series_rows(ts: np.ndarray, vals: np.ndarray, lens: np.ndarray,
@@ -571,13 +590,22 @@ class TpuBackend:
         self.exec_cache_hits = 0
         self.exec_cache_misses = 0
 
-    def _count_exec(self, key: Tuple) -> None:
+    def _count_exec(self, key: Tuple, probe=None) -> None:
+        """Executable reuse accounting + compile/cost profiling
+        (obs/devprof.py). ``probe`` is a ``() -> Compiled`` lazy cost
+        probe over the abstract call signature: registered on the key's
+        FIRST sight only, compiled on demand by the first
+        ``&explain=analyze`` touching the executable (serving
+        dispatches never pay it)."""
         with self._exec_lock:
-            if key in self._exec_keys:
-                self.exec_cache_hits += 1
-            else:
+            first = key not in self._exec_keys
+            if first:
                 self._exec_keys.add(key)
                 self.exec_cache_misses += 1
+            else:
+                self.exec_cache_hits += 1
+        devprof.note_dispatch("packed", key, first,
+                              probe=probe if first else None)
 
     def executable_cache_stats(self) -> Dict[str, int]:
         """Packed-kernel + tilestore executable-reuse counters (the
@@ -677,8 +705,12 @@ class TpuBackend:
         if s_bucket != S:
             ts, vals, lens = _pad_series_rows(ts, vals, lens, s_bucket)
         if func in _GATHER_FUNCS:
-            self._count_exec(("gather", func, s_bucket, N, t_bucket,
-                              w_bound))
+            self._count_exec(
+                ("gather", func, s_bucket, N, t_bucket, w_bound),
+                probe=_lower_probe(_window_gather, func, w_bound,
+                                   _abstract(ts), _abstract(vals),
+                                   _abstract(lens), w0s, w0e, step,
+                                   t_bucket, scalar))
             out = _window_gather(func, w_bound, ts, vals, lens,
                                  w0s, w0e, step, t_bucket, scalar)
         else:
@@ -692,7 +724,12 @@ class TpuBackend:
                     self._count_exec(("pallas", func, s_bucket, N, nsteps))
                     # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
                     return np.asarray(out)[:S]
-            self._count_exec(("endpoint", func, s_bucket, N, t_bucket))
+            self._count_exec(
+                ("endpoint", func, s_bucket, N, t_bucket),
+                probe=_lower_probe(_window_endpoint, func,
+                                   _abstract(ts), _abstract(vals),
+                                   _abstract(lens), w0s, w0e, step,
+                                   t_bucket, scalar))
             out = _window_endpoint(func, ts, vals, lens,
                                    w0s, w0e, step, t_bucket, scalar)
         # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
@@ -742,8 +779,13 @@ class TpuBackend:
             step_v[sl] = m.step
         if func in _GATHER_FUNCS:
             w_bound = max(m.w_bound for m in members)
-            self._count_exec(("gather-b", func, s_bucket, N, t_bucket,
-                              w_bound))
+            self._count_exec(
+                ("gather-b", func, s_bucket, N, t_bucket, w_bound),
+                probe=_lower_probe(_window_gather, func, w_bound,
+                                   _abstract(ts), _abstract(vals),
+                                   _abstract(lens), _abstract(w0s_v),
+                                   _abstract(w0e_v), _abstract(step_v),
+                                   t_bucket, scalar))
             dev = _window_gather(func, w_bound, ts, vals, lens,
                                  jnp.asarray(w0s_v), jnp.asarray(w0e_v),
                                  jnp.asarray(step_v), t_bucket, scalar)
@@ -751,7 +793,13 @@ class TpuBackend:
             # rate-family members ride _window_endpoint here (the Pallas
             # boundary-extract kernel takes scalar grids); exact f64 on
             # both paths — bit-for-bit, pinned by the parity test
-            self._count_exec(("endpoint-b", func, s_bucket, N, t_bucket))
+            self._count_exec(
+                ("endpoint-b", func, s_bucket, N, t_bucket),
+                probe=_lower_probe(_window_endpoint, func,
+                                   _abstract(ts), _abstract(vals),
+                                   _abstract(lens), _abstract(w0s_v),
+                                   _abstract(w0e_v), _abstract(step_v),
+                                   t_bucket, scalar))
             dev = _window_endpoint(func, ts, vals, lens,
                                    jnp.asarray(w0s_v), jnp.asarray(w0e_v),
                                    jnp.asarray(step_v), t_bucket, scalar)

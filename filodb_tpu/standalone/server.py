@@ -109,6 +109,19 @@ DEFAULTS = {
     "trace-sample-rate": 1.0,
     "trace-max-traces": 256,
     "slow-query-ms": 1000.0,
+    # self-monitoring (obs/selfmon.py): a per-process loop snapshots
+    # the metrics registry in-process every interval and ingests the
+    # samples into the reserved __selfmon__ dataset through the normal
+    # ingest path (WAL + driver replay when stream-dir is set; direct
+    # ingest + flush otherwise), tagged to the reserved __selfmon__
+    # tenant (background priority, forced charges). PromQL over our own
+    # telemetry: /promql/__selfmon__/api/v1/query_range?query=...
+    "self-monitor": False,
+    "self-monitor-interval-s": 5.0,
+    # direct-ingest mode flush cadence (ticks between flushes; the
+    # internal shard's ingest watermark — the results cache's
+    # freshness input — advances on flush)
+    "self-monitor-flush-ticks": 4,
     # group-commit fsync for the durable ingest streams (ROADMAP
     # follow-up: per-append fsync stalls on shared container disks).
     # Appends fsync at most every this-many ms (or 1MB unsynced);
@@ -294,6 +307,11 @@ class FiloServer:
         self.bus_client = None
         self._bus_tick_stop = threading.Event()
         self._bus_tick_thread: Optional[threading.Thread] = None
+        # self-monitoring (obs/selfmon.py): loop + its internal
+        # dataset's dedicated stream/driver (None when off)
+        self.selfmon = None
+        self._selfmon_stream = None
+        self._selfmon_driver = None
 
     def _make_qos_budgets(self):
         """Per-tenant token-bucket budgets from the qos-* knobs (None
@@ -639,8 +657,15 @@ class FiloServer:
             self.tenant_metering = TenantMetering(
                 self.card_trackers, interval_s=meter_s).start()
             self.http.tenant_metering = self.tenant_metering
+        # host-level series from day one: RSS/fds/threads/GC/uptime +
+        # filodb_build_info ride every exposition build (and therefore
+        # the self-monitoring ingest below)
+        from filodb_tpu.obs.process import register_process_collector
+        register_process_collector()
         if streaming:
             self._start_ingestion()
+        if self.config.get("self-monitor"):
+            self._start_selfmon()
         # serving-path GC hygiene: move the (large, permanent) startup
         # object graph out of the collector's reach and make full
         # collections 10x rarer — a gen-2 sweep over jax/XLA module
@@ -791,6 +816,66 @@ class FiloServer:
                 spread=int(self.config.get("default-spread", 1)),
                 spread_provider=self.spread_provider,
                 port=int(self.config["gateway-port"])).start()
+
+    # -- self-monitoring (obs/selfmon.py) ---------------------------------
+    def _start_selfmon(self) -> None:
+        """Wire the reserved internal dataset and start the loop.
+
+        One internal shard per process, numbered by worker ordinal so
+        a supervisor fleet sharing data/stream dirs never collides:
+        worker k's internal series live in shard k of ``__selfmon__``
+        (stamped with a ``worker`` label), each worker serves its own
+        via a strictly-local planner. The shard gets its OWN
+        CardinalityTracker — internal series are invisible to user
+        cardinality accounting and quotas. With a stream-dir the loop
+        appends to a dedicated WAL and a normal IngestionDriver
+        replays it (recovery included: self-telemetry survives worker
+        restarts); memory-only deployments ingest directly and flush
+        on a tick cadence so the freshness watermark still advances."""
+        import os
+
+        from filodb_tpu.core.cardinality import CardinalityTracker
+        from filodb_tpu.obs.selfmon import SELFMON_DATASET, SelfMonitor
+        wid = self.config.get("worker-id")
+        shard_num = int(wid or 0)
+        ref = DatasetRef(SELFMON_DATASET)
+        shard = self.store.setup(
+            ref, shard_num,
+            num_groups=2,
+            max_chunk_rows=self.config["max-chunks-size"],
+            bootstrap=self.store.column_store is not None,
+            card_tracker=CardinalityTracker())
+        self.http.shards_by_dataset[SELFMON_DATASET] = \
+            self.store.shards(ref)
+        stream = None
+        if self.config.get("stream-dir"):
+            from filodb_tpu.ingest import LogIngestionStream
+            path = os.path.join(self.config["stream-dir"], "selfmon",
+                                f"shard={shard_num}", "stream.log")
+            stream = LogIngestionStream(
+                path, DEFAULT_SCHEMAS,
+                group_commit_s=float(self.config.get(
+                    "stream-group-commit-ms", 0)) / 1000)
+            self._selfmon_stream = stream
+            from filodb_tpu.ingest import IngestionDriver
+            self._selfmon_driver = IngestionDriver(
+                shard, stream, mapper=None,
+                flush_interval_s=float(self.config.get(
+                    "flush-interval-s", 2.0)),
+                ingest_batch_records=int(self.config.get(
+                    "ingest-batch-records", 64)))
+            self._selfmon_driver.start()
+        self.selfmon = SelfMonitor(
+            self.http.build_exposition, shard,
+            schemas=DEFAULT_SCHEMAS, stream=stream,
+            interval_s=float(self.config.get(
+                "self-monitor-interval-s", 5.0)),
+            node=self.node_id,
+            worker_id=int(wid) if wid is not None else None,
+            flush_every_ticks=int(self.config.get(
+                "self-monitor-flush-ticks", 4)))
+        self.http.selfmon = self.selfmon
+        self.selfmon.start()
 
     # -- elastic recovery (shard reassignment on node loss) ---------------
     # ShardManager.scala:28 assignShardsToNodes / IngestionActor.scala:297
@@ -1028,6 +1113,15 @@ class FiloServer:
         return rows
 
     def stop(self) -> None:
+        if self.selfmon is not None:
+            self.selfmon.stop()
+        if self._selfmon_driver is not None:
+            self._selfmon_driver.stop()
+        if self._selfmon_stream is not None:
+            try:
+                self._selfmon_stream.close()
+            except OSError:
+                pass
         self._bus_tick_stop.set()
         if self._bus_tick_thread is not None:
             self._bus_tick_thread.join(timeout=5)
@@ -1078,6 +1172,10 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir")
     p.add_argument("--stream-dir")
     p.add_argument("--gateway-port", type=int)
+    p.add_argument("--self-monitor", action="store_true", default=None,
+                   help="ingest this node's own metrics into the "
+                        "reserved __selfmon__ dataset (PromQL over "
+                        "our own telemetry)")
     p.add_argument("--seed-dev-data", action="store_true",
                    help="generate dev series on startup")
     args = p.parse_args(argv)
@@ -1086,7 +1184,7 @@ def main(argv=None) -> int:
         with open(args.config) as f:
             config.update(json.load(f))
     for k in ("port", "num_shards", "dataset", "data_dir", "stream_dir",
-              "gateway_port"):
+              "gateway_port", "self_monitor"):
         v = getattr(args, k)
         if v is not None:
             config[k.replace("_", "-")] = v

@@ -134,6 +134,63 @@ def test_whole_exposition_parses_and_validates(server):
     assert validate_histograms(families) >= 3
 
 
+def test_registry_wide_histogram_validator_clean_on_live(server):
+    """The library validator (obs.metrics.validate_histogram_families)
+    over the FULL live exposition: every histogram family — per label
+    set — has cumulative buckets, +Inf == _count, and an emitted _sum.
+    The tier-1 pin for the self-consistency satellite."""
+    from filodb_tpu.obs.metrics import validate_histogram_families
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert validate_histogram_families(text) == []
+
+
+def test_histogram_validator_flags_violations():
+    from filodb_tpu.obs.metrics import validate_histogram_families
+    base = ("# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\n'
+            'h_bucket{le="1"} 3\n'            # NOT cumulative
+            'h_bucket{le="+Inf"} 9\n'
+            "h_count 8\n")                     # +Inf != count, no _sum
+    v = validate_histogram_families(base)
+    assert any("not cumulative" in m for m in v)
+    assert any("+Inf bucket" in m for m in v)
+    assert any("_sum not emitted" in m for m in v)
+    # missing +Inf
+    v2 = validate_histogram_families(
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 3\nh_count 3\nh_sum 1.5\n')
+    assert any("no +Inf bucket" in m for m in v2)
+    # clean twin per label set
+    clean = ("# HELP h x\n# TYPE h histogram\n"
+             'h_bucket{t="a",le="0.1"} 2\n'
+             'h_bucket{t="a",le="+Inf"} 4\n'
+             'h_count{t="a"} 4\nh_sum{t="a"} 0.5\n'
+             'h_bucket{t="b",le="0.1"} 1\n'
+             'h_bucket{t="b",le="+Inf"} 1\n'
+             'h_count{t="b"} 1\nh_sum{t="b"} 0.1\n')
+    assert validate_histogram_families(clean) == []
+
+
+def test_registry_walk_matches_rendered_text(server):
+    """ExpositionBuilder.families() — the structural walk the
+    self-monitoring loop reads — agrees sample-for-sample with the
+    rendered /metrics text."""
+    builder = server.http.build_exposition()
+    walked = [(name, labels)
+              for _fam, _mt, _help, samples in builder.families()
+              for name, labels, _v in samples]
+    families = parse_exposition(builder.render())
+    rendered = [(name, labels)
+                for _fam, (_mt, samples) in families.items()
+                for name, labels, _v in samples]
+    # same sample count (the walk dedupes exactly like render) and the
+    # same sample-name universe
+    assert len(walked) == len(set(walked)) == len(rendered)
+    assert {n for n, _ in walked} == {n for n, _ in rendered}
+
+
 def test_label_escaping_survives_hostile_values(server):
     # a label value with quote/backslash/newline must stay parseable
     from filodb_tpu.obs.metrics import ExpositionBuilder

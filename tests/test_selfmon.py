@@ -1,0 +1,345 @@
+"""Self-monitoring pipeline (obs/selfmon.py): the node ingests its own
+metrics into the reserved __selfmon__ dataset through the normal ingest
+path and serves them back over PromQL.
+
+Pins the PR acceptance scenario: with --self-monitor on, a range query
+over filodb_query_latency_seconds_bucket (and a QoS tenant family)
+through /api/v1/query_range returns real, fresh series produced by the
+in-process loop — and user-dataset cardinality accounting is untouched
+by internal series. Plus: the reserved tenant's forced-charge QoS
+semantics under sustained overload, worker labeling, schema selection,
+process-collector families, and selfmon-on byte-transparency for user
+queries.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.obs.selfmon import (SELFMON_DATASET, SELFMON_TENANT,
+                                    SelfMonitor, _schema_for)
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _query_range(port, ds, **params):
+    return _get(port, f"/promql/{ds}/api/v1/query_range", **params)
+
+
+# ---------------------------------------------------------------------------
+# unit: collection + schema selection
+# ---------------------------------------------------------------------------
+
+def _fake_source():
+    b = obs_metrics.ExpositionBuilder()
+    b.sample("app_requests_total", {"code": "200"}, 7, mtype="counter",
+             help="requests")
+    b.sample("app_requests_total", {"code": "500"}, 1, mtype="counter",
+             help="requests")
+    b.sample("app_temperature", {}, 21.5, help="gauge")
+    b.sample("app_bad", {}, "not-a-number", help="skipped")
+    h = obs_metrics.Histogram("app_lat_seconds", "lat", (0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    b.histogram(h)
+    return b
+
+
+def test_schema_selection():
+    assert _schema_for("counter", "x_total") == "prom-counter"
+    assert _schema_for("histogram", "x_bucket") == "prom-counter"
+    assert _schema_for("gauge", "x_bucket") == "prom-counter"
+    assert _schema_for("gauge", "x_count") == "prom-counter"
+    assert _schema_for("gauge", "x") == "gauge"
+    assert _schema_for("", "x") == "gauge"
+
+
+def test_collect_once_ingests_all_families():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    ref = DatasetRef(SELFMON_DATASET)
+    shard = store.setup(ref, 0, num_groups=2)
+    sm = SelfMonitor(_fake_source, shard, interval_s=3600,
+                     node="nodeX", flush_every_ticks=1)
+    n = sm.collect_once(now_ms=T0 * 1000)
+    # 2 counter series + 1 gauge + histogram (2 finite + Inf buckets +
+    # sum + count = 5); the bad value is skipped
+    assert n == 2 + 1 + 5
+    from filodb_tpu.core.index import ColumnFilter
+    parts = shard.lookup_partitions(
+        [ColumnFilter("_metric_", "eq", "app_requests_total")],
+        0, 1 << 62)
+    labels = sorted(dict(p.part_key.labels)["code"] for p in parts)
+    assert labels == ["200", "500"]
+    for p in parts:
+        lm = dict(p.part_key.labels)
+        assert lm["_ws_"] == SELFMON_TENANT
+        assert lm["_ns_"] == "nodeX"
+        # counter families ingest under the counter schema (rate() gets
+        # reset correction)
+        assert p.schema.name == "prom-counter"
+    (gp,) = shard.lookup_partitions(
+        [ColumnFilter("_metric_", "eq", "app_temperature")], 0, 1 << 62)
+    assert gp.schema.name == "gauge"
+    # histogram children carried the le label through
+    bucket_parts = shard.lookup_partitions(
+        [ColumnFilter("_metric_", "eq", "app_lat_seconds_bucket")],
+        0, 1 << 62)
+    les = sorted(dict(p.part_key.labels)["le"] for p in bucket_parts)
+    assert les == ["+Inf", "0.1", "1"]
+    snap = sm.snapshot()
+    assert snap["ticks"] == 1 and snap["samples_ingested"] == n
+    assert snap["errors"] == 0
+
+
+def test_worker_label_stamped():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    ref = DatasetRef(SELFMON_DATASET)
+    shard = store.setup(ref, 3, num_groups=2)
+    sm = SelfMonitor(_fake_source, shard, interval_s=3600,
+                     node="n", worker_id=3)
+    sm.collect_once(now_ms=T0 * 1000)
+    from filodb_tpu.core.index import ColumnFilter
+    parts = shard.lookup_partitions(
+        [ColumnFilter("_metric_", "eq", "app_temperature")], 0, 1 << 62)
+    assert [dict(p.part_key.labels)["worker"] for p in parts] == ["3"]
+
+
+def test_tick_is_idempotent_per_series_set():
+    """Two ticks over the same families grow samples, not series —
+    cardinality in the internal dataset is bounded by the metric
+    surface, not by uptime."""
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    ref = DatasetRef(SELFMON_DATASET)
+    shard = store.setup(ref, 0, num_groups=2)
+    sm = SelfMonitor(_fake_source, shard, interval_s=3600,
+                     flush_every_ticks=10)
+    sm.collect_once(now_ms=T0 * 1000)
+    count1 = shard.card_tracker.series_count(()) \
+        if shard.card_tracker else None
+    from filodb_tpu.core.index import ColumnFilter
+    n_parts1 = len(shard.lookup_partitions([], 0, 1 << 62))
+    sm.collect_once(now_ms=T0 * 1000 + 10_000)
+    n_parts2 = len(shard.lookup_partitions([], 0, 1 << 62))
+    assert n_parts1 == n_parts2
+    assert count1 is None or count1 == shard.card_tracker.series_count(())
+
+
+# ---------------------------------------------------------------------------
+# e2e: the acceptance scenario
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def selfmon_server():
+    srv = FiloServer({
+        "num-shards": 2, "port": 0,
+        "self-monitor": True,
+        "self-monitor-interval-s": 0.25,
+        "self-monitor-flush-ticks": 1,
+        # a budgeted tenant so the per-tenant QoS families exist
+        "qos-tenant-overrides": {"budgeted": [50, 200]},
+        "tenant-metering-interval-s": 30,
+    }).start()
+    srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
+    # serve queries so the latency histogram + tenant families populate
+    for _ in range(2):
+        _query_range(srv.port, "timeseries",
+                     query="rate(http_requests_total[5m])",
+                     start=T0 + 300, end=T0 + 500, step=60,
+                     tenant="budgeted")
+    yield srv
+    srv.stop()
+
+
+def _fresh_series(srv, metric, extra_q=()):
+    """Range-query the internal dataset around now; retries briefly so
+    the loop has ticked at least once."""
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        now = int(time.time())
+        out = _query_range(
+            srv.port, SELFMON_DATASET, query=metric,
+            start=now - 60, end=now + 2, step=1,
+            **dict(extra_q))
+        assert out["status"] == "success"
+        res = out["data"]["result"]
+        if res:
+            return res, now
+        time.sleep(0.3)
+    raise AssertionError(f"no fresh internal series for {metric}")
+
+
+def test_selfmon_e2e_promql_over_own_metrics(selfmon_server):
+    srv = selfmon_server
+    # user-dataset cardinality BEFORE reading internal series
+    user_counts = {
+        sh: t.series_count(()) for sh, t in srv.card_trackers.items()}
+
+    res, now = _fresh_series(srv, "filodb_query_latency_seconds_bucket")
+    # real histogram series with le labels, values fresh (timestamps
+    # within the query window ending now)
+    les = {r["metric"].get("le") for r in res}
+    assert "+Inf" in les and len(les) > 3
+    for r in res:
+        assert r["metric"]["_ws_"] == SELFMON_TENANT
+        ts_last = float(r["values"][-1][0])
+        assert now - 60 <= ts_last <= now + 2
+    inf_row = [r for r in res if r["metric"].get("le") == "+Inf"][0]
+    assert float(inf_row["values"][-1][1]) >= 2  # the seeded queries
+
+    # one QoS tenant family, produced by the loop too
+    res2, _ = _fresh_series(srv, "filodb_tenant_budget_remaining")
+    tenants = {r["metric"].get("tenant") for r in res2}
+    assert "budgeted" in tenants
+
+    # internal series did NOT touch user-dataset cardinality
+    for sh, t in srv.card_trackers.items():
+        assert t.series_count(()) == user_counts[sh]
+    # ...and the internal dataset has its own tracker with its own
+    # (nonzero) counts, isolated under the reserved workspace
+    sm_shards = srv.store.shards(DatasetRef(SELFMON_DATASET))
+    assert sm_shards and sm_shards[0].card_tracker is not None
+    assert sm_shards[0].card_tracker.series_count((SELFMON_TENANT,)) > 0
+
+
+def test_selfmon_loop_health_rides_metrics(selfmon_server):
+    srv = selfmon_server
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        text = r.read().decode()
+    assert "filodb_selfmon_ticks_total" in text
+    assert "filodb_selfmon_alive 1" in text
+    assert "filodb_selfmon_last_tick_age_seconds" in text
+    # process-collector families ride every exposition (satellite)
+    assert "filodb_process_resident_memory_bytes" in text
+    assert "filodb_process_open_fds" in text
+    assert "filodb_process_gc_collections_total" in text
+    assert "filodb_process_uptime_seconds" in text
+    assert 'filodb_build_info{' in text
+    # the loop's own families become internal series on the next tick
+    res, _ = _fresh_series(srv, "filodb_selfmon_ticks_total")
+    vals = [float(v) for _t, v in res[0]["values"]]
+    assert vals == sorted(vals) and vals[-1] >= 1  # monotone counter
+
+
+def test_selfmon_user_responses_unchanged(selfmon_server):
+    """Self-monitoring on must not perturb user-dataset responses: the
+    data section matches a selfmon-off server byte-for-byte (modulo the
+    wall-clock timings block)."""
+    srv = selfmon_server
+    plain = FiloServer({"num-shards": 2, "port": 0}).start()
+    try:
+        plain.seed_dev_data(n_samples=60, n_instances=3,
+                            start_ms=T0 * 1000)
+        # cache=false: both servers must evaluate fresh (the selfmon
+        # fixture's earlier queries warmed ITS results cache, which is
+        # legitimate state, not a selfmon artifact)
+        q = dict(query="rate(http_requests_total[5m])",
+                 start=T0 + 300, end=T0 + 500, step=60, cache="false")
+        a = _query_range(srv.port, "timeseries", **q)
+        b = _query_range(plain.port, "timeseries", **q)
+        a["stats"].pop("timings", None)
+        b["stats"].pop("timings", None)
+        assert a == b
+    finally:
+        plain.stop()
+
+
+# ---------------------------------------------------------------------------
+# QoS: the reserved tenant charges FORCED (never bounces off a drained
+# bucket) — the regression the satellite demands
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def overloaded_server():
+    srv = FiloServer({
+        "num-shards": 2, "port": 0,
+        # tiny budget for EVERY tenant (selfmon included): rate 1/s,
+        # burst 5 — a single real query prices far above this
+        "qos-tenant-rate": 1, "qos-tenant-burst": 5,
+        "qos-shed-degraded": False,     # no ladder: over budget = 429
+    }).start()
+    srv.seed_dev_data(n_samples=120, n_instances=4, start_ms=T0 * 1000)
+    yield srv
+    srv.stop()
+
+
+def test_selfmon_tenant_never_bounces_under_overload(overloaded_server):
+    srv = overloaded_server
+    q = dict(query="rate(http_requests_total[5m])",
+             start=T0 + 300, end=T0 + 1100, step=10, cache="false")
+
+    # sustained overload: the default tenant's bucket drains and its
+    # queries bounce with 429
+    saw_429 = False
+    for _ in range(6):
+        try:
+            _query_range(srv.port, "timeseries", **q)
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            saw_429 = True
+            e.read()
+    assert saw_429, "overload harness never tripped the budget"
+
+    # the reserved tenant keeps answering 200 — forced charges drive
+    # its bucket into debt but never reject
+    for _ in range(4):
+        out = _query_range(srv.port, "timeseries",
+                           tenant=SELFMON_TENANT, **q)
+        assert out["status"] == "success"
+        assert not any("shed(" in w for w in
+                       out.get("warnings", []) or [])
+    bucket = srv.http.admission.budgets.bucket(SELFMON_TENANT)
+    assert bucket is not None
+    assert bucket.forced_charges >= 4
+    assert bucket.remaining() < 0          # deep in debt, still serving
+
+
+def test_selfmon_tenant_runs_background_priority(overloaded_server):
+    """No explicit priority + the reserved tenant = background class
+    (self-telemetry must not preempt interactive work); an explicit
+    priority hint still wins."""
+    srv = overloaded_server
+    from filodb_tpu.query import qos as qos_mod
+    seen = {}
+    orig = qos_mod.activate
+
+    def spy(ctx):
+        if ctx is not None:
+            seen[ctx.tenant] = (ctx.priority, ctx.forced)
+        return orig(ctx)
+    qos_mod.activate = spy
+    try:
+        q = dict(query="rate(http_requests_total[5m])",
+                 start=T0 + 300, end=T0 + 500, step=60)
+        _query_range(srv.port, "timeseries", tenant=SELFMON_TENANT, **q)
+        _query_range(srv.port, "timeseries", tenant=SELFMON_TENANT,
+                     priority="interactive", **q)
+    finally:
+        qos_mod.activate = orig
+    # last call wins in the dict; check both were observed
+    assert seen[SELFMON_TENANT][1] is True          # forced either way
+    # first call defaulted to background — re-run to capture separately
+    seen.clear()
+    qos_mod.activate = spy
+    try:
+        _query_range(srv.port, "timeseries", tenant=SELFMON_TENANT,
+                     query="up", time=T0)
+    finally:
+        qos_mod.activate = orig
+    prio, forced = seen[SELFMON_TENANT]
+    assert prio == qos_mod.PRIORITY_BACKGROUND and forced

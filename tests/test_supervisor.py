@@ -68,6 +68,23 @@ def test_worker_config_derivation():
     assert all(c["gateway-port"] is None for c in cfgs[1:])
 
 
+def test_worker_config_propagates_self_monitor():
+    """--self-monitor rides into every worker: each runs its OWN loop
+    over its own internal shard (shard number = worker-id, so the
+    shared stream/data dirs never collide) and stamps its ordinal as
+    the worker label on internal series."""
+    base = {"num-shards": 4, "self-monitor": True,
+            "self-monitor-interval-s": 2.5, "serving-workers": 2,
+            "supervisor-port": 0, "run-dir": "/x"}
+    ports = [9001, 9002]
+    cfgs = [worker_config(base, i, 2, ports, 8080, 7000)
+            for i in range(2)]
+    for i, cfg in enumerate(cfgs):
+        assert cfg["self-monitor"] is True
+        assert cfg["self-monitor-interval-s"] == 2.5
+        assert cfg["worker-id"] == i
+
+
 def test_worker_config_fd_fallback():
     cfg = worker_config({"num-shards": 4}, 1, 2, [9001, 9002], 8080,
                         7000, accept_fd=13)
@@ -214,6 +231,51 @@ def test_merge_expositions_injects_worker_label():
         in lines
     # merged output re-parses cleanly
     assert parse_exposition(out)
+
+
+def test_merge_expositions_idempotent():
+    """merge(merge(x)) == merge(x): re-merging an already-merged
+    exposition (a supervisor-of-supervisors scrape, a re-aggregated
+    payload) is a no-op — the worker label injected by the first merge
+    is KEPT, not clobbered, and HELP/TYPE blocks survive. This is also
+    what protects self-monitoring's own ``worker``-labeled internal
+    series through the supervisor's aggregate view."""
+    merged = merge_expositions({"0": _W0, "1": _W1})
+    again = merge_expositions({"sup": merged})
+    assert again == merged
+    # a sample that already carried a worker label keeps it even when
+    # merged under a different worker key
+    assert 'worker="sup"' not in again
+
+
+def test_merge_expositions_idempotent_on_real_worker_payloads():
+    """The same property pinned on a REAL worker payload — a live
+    FiloServer /metrics body, histograms, escapes, and all — since the
+    supervisor's self-monitoring view reads through this path."""
+    import urllib.request
+
+    from filodb_tpu.obs.metrics import validate_histogram_families
+    from filodb_tpu.standalone.server import FiloServer
+    srv = FiloServer({"num-shards": 2, "port": 0}).start()
+    try:
+        srv.seed_dev_data(n_samples=30, n_instances=2,
+                          start_ms=1_600_000_000_000)
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+            f"query_range?query=up&start=1600000300&end=1600000400"
+            f"&step=60", timeout=60).read()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=60) as r:
+            body = r.read().decode()
+    finally:
+        srv.stop()
+    merged = merge_expositions({"0": body, "1": body})
+    again = merge_expositions({"0": merged})
+    assert again == merged
+    # histogram self-consistency survives the merge (registry-wide
+    # validator: cumulative buckets, +Inf == _count, _sum emitted)
+    assert validate_histogram_families(merged) == []
 
 
 def test_merged_exposition_passes_format_validator():
