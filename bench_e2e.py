@@ -1086,6 +1086,158 @@ def measure_selfmon_overhead(clients=8, duration_s=2.5,
     return out
 
 
+def measure_rules_overhead(clients=8, duration_s=2.5,
+                           rule_interval_s=1.0):
+    """The dashboard-conversion win (recording rules, filodb_tpu/rules):
+    the SAME dashboard aggregate measured two ways on one live server —
+    (a) as a direct warm-cache query over the raw counters, and (b) as
+    a one-series read of the recording rule's precomputed output from
+    /promql/__rules__. The rule converts the per-user rate() work into
+    O(rules) background ticks, so (b) should serve at >= the direct
+    warm-cache qps while the standing cost is the rule-tick duty cycle
+    (reported from the engine's own filodb_rule_tick_seconds
+    histogram, noise-free)."""
+    out = {"clients": clients, "rule_interval_s": rule_interval_s}
+    # seed AT wall-now: rule ticks evaluate at now and must see data
+    now_s = int(time.time())
+    seed_start = (now_s - SEED_SAMPLES * 10) * 1000
+    port = _free_port()
+    cfg = {
+        "num-shards": 4, "port": port, "gateway-port": None,
+        "seed-dev-data": True, "seed-start-ms": seed_start,
+        "seed-samples": SEED_SAMPLES, "seed-instances": N_INSTANCES,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "max-inflight-queries": 8, "grpc-port": None,
+        # old steps settle fast so consecutive rule ticks are
+        # cache-warm tail recomputes
+        "results-cache-hot-window-ms": 2_000.0,
+        "rules-eval-span-steps": 8,
+        "rules": {"groups": [{
+            "name": "bench", "interval": rule_interval_s, "rules": [
+                {"record": "bench:req:rate5m",
+                 "expr": "sum(rate(http_requests_total[5m]))"}]}]},
+    }
+    proc, _line = _spawn_node(cfg)
+    try:
+        # let the engine tick a few times (first ticks create the
+        # internal series — a one-time transient)
+        time.sleep(4 * rule_interval_s)
+
+        # both paths use the BENCH_r08 dashboard methodology: a
+        # SLIDING window (refresh interval shorter than the step, so
+        # most refreshes repeat the window and a slide recomputes only
+        # the tail). The direct path's tail recompute re-runs rate()
+        # over every instance's counter; the recorded path's tail is
+        # one precomputed series — that asymmetry IS the conversion.
+        SLIDE_S = 0.5
+        t_base = time.perf_counter()
+        d_base = now_s - 3000
+
+        def one_direct(cl):
+            slide = int((time.perf_counter() - t_base) / SLIDE_S)
+            start = d_base + (slide % 20) * 60
+            t0 = time.perf_counter()
+            raw = cl.get_raw(
+                "/promql/timeseries/api/v1/query_range",
+                query="sum(rate(http_requests_total[5m]))",
+                start=start, end=start + 1800, step=60)
+            dt = time.perf_counter() - t0
+            assert raw.startswith(b'{"status":"success"'), raw[:120]
+            return dt
+
+        def one_recorded(cl):
+            # the recorded series' natural dashboard: the window
+            # slides with the wall clock at the rule's own cadence
+            now = int(time.time())
+            t0 = time.perf_counter()
+            raw = cl.get_raw(
+                "/promql/__rules__/api/v1/query_range",
+                query="bench:req:rate5m",
+                start=now - 90, end=now - 2,
+                step=max(1, int(rule_interval_s)))
+            dt = time.perf_counter() - t0
+            assert raw.startswith(b'{"status":"success"'), raw[:120]
+            return dt
+
+        def run_level(one):
+            lats = []
+            lock = threading.Lock()
+            t_end = [0.0]
+
+            def loop(cid):
+                time.sleep(cid * 0.002)
+                cl = KeepAliveClient(port)
+                while time.perf_counter() < t_end[0]:
+                    dt = one(cl)
+                    with lock:
+                        lats.append(dt)
+                cl.close()
+            t0 = time.perf_counter()
+            t_end[0] = t0 + duration_s
+            threads = [threading.Thread(target=loop, args=(c,))
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            lats_ms = np.asarray(lats) * 1000
+            return {"queries": len(lats),
+                    "qps": round(len(lats) / wall, 1),
+                    "p50_ms": round(float(np.percentile(lats_ms, 50)),
+                                    2),
+                    "p99_ms": round(float(np.percentile(lats_ms, 99)),
+                                    2)}
+
+        warm = KeepAliveClient(port)
+        for _ in range(4):          # compile + warm both shapes
+            one_direct(warm)
+            one_recorded(warm)
+        # interleaved trials, warm-up dropped (the selfmon-bench
+        # methodology: single trials swing +/-20% on a 1-core rig)
+        runs = {"direct_warm_cache": [], "recorded_series": []}
+        for t in range(3):
+            order = (("direct_warm_cache", one_direct),
+                     ("recorded_series", one_recorded)) if t % 2 == 0 \
+                else (("recorded_series", one_recorded),
+                      ("direct_warm_cache", one_direct))
+            for name, fn in order:
+                runs[name].append(run_level(fn))
+        for name, rs in runs.items():
+            steady = rs[1:] if len(rs) > 1 else rs
+            out[name] = {
+                "qps": round(sum(r["qps"] for r in steady)
+                             / len(steady), 1),
+                "p50_ms": round(sum(r["p50_ms"] for r in steady)
+                                / len(steady), 2),
+                "p99_ms": round(sum(r["p99_ms"] for r in steady)
+                                / len(steady), 2),
+                "all_qps": [r["qps"] for r in rs],
+            }
+        out["qps_ratio_recorded_vs_direct"] = round(
+            out["recorded_series"]["qps"]
+            / max(out["direct_warm_cache"]["qps"], 1e-9), 3)
+        # the standing cost, from the engine's own histogram: mean
+        # tick wall seconds / interval = duty cycle
+        tick_sum = _scrape_metric(warm, "rule_tick_seconds_sum")
+        tick_n = _scrape_metric(warm, "rule_tick_seconds_count")
+        if tick_n:
+            out["rule_ticks"] = int(tick_n)
+            out["tick_ms_avg"] = round(1000 * tick_sum / tick_n, 2)
+            out["rule_duty_cycle"] = round(
+                (tick_sum / tick_n) / rule_interval_s, 5)
+        out["rule_samples_written"] = _scrape_metric(
+            warm, "rule_samples_written_total")
+        warm.close()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return out
+
+
 def main():
     out = measure()
     try:
@@ -1100,6 +1252,10 @@ def main():
         out["selfmon_overhead"] = measure_selfmon_overhead()
     except Exception as e:  # noqa: BLE001
         out["selfmon_overhead"] = {"error": repr(e)}
+    try:
+        out["rules_overhead"] = measure_rules_overhead()
+    except Exception as e:  # noqa: BLE001
+        out["rules_overhead"] = {"error": repr(e)}
     print(json.dumps(out))
 
 

@@ -47,6 +47,13 @@ from filodb_tpu.query.model import (GridResult, QueryError, QueryLimitError,
 
 _ROUTE = re.compile(r"^/promql/(?P<ds>[^/]+)/api/v1/(?P<rest>.+)$")
 
+# reserved internal datasets: strictly node-local planners (no
+# fan-out / mesh / mapper), own cardinality accounting. __selfmon__
+# holds self-ingested telemetry; __rules__ holds recording-rule outputs
+# and the synthetic ALERTS state series (dataset name == tenant name by
+# the same convention as __selfmon__).
+INTERNAL_DATASETS = (SELFMON_DATASET, qos.RULES_TENANT)
+
 _QLAT_HELP = ("End-to-end query latency in seconds at the HTTP edge "
               "(parse + plan + execute + encode)")
 
@@ -187,6 +194,10 @@ class FiloHttpServer:
         # SelfMonitor loop (obs/selfmon.py) whose liveness gauges ride
         # /metrics
         self.selfmon = None
+        # set by the standalone server when rules are configured: the
+        # RulesEngine (filodb_tpu/rules) behind /api/v1/rules and
+        # /api/v1/alerts; its evaluations call rule_eval_range below
+        self.rules = None
         # serving fast path: parsed-plan LRU (start/end abstracted out of
         # the key; dashboards re-issuing the same text skip parse+plan).
         # Invalidation: shard-topology events from the mapper, plus the
@@ -511,6 +522,10 @@ class FiloHttpServer:
             return 200, {"status": "success",
                          "summary": self.slow_log.snapshot(),
                          "data": self.slow_log.records(limit)}
+        if path == "/api/v1/rules":
+            return self._rules_api(qs)
+        if path == "/api/v1/alerts":
+            return self._alerts_api(qs)
         m = re.match(r"^/api/v1/cluster/(?P<ds>[^/]+)/status$", path)
         if m:
             return 200, self._cluster_status(m.group("ds"))
@@ -589,7 +604,7 @@ class FiloHttpServer:
                       or qos.DEFAULT_TENANT)
             raw_priority = self._param(qs, "priority") or priority_hdr
             priority = qos.parse_priority(raw_priority)
-            selfmon_tenant = tenant == qos.SELFMON_TENANT
+            selfmon_tenant = tenant in qos.INTERNAL_TENANTS
             if selfmon_tenant and not raw_priority:
                 priority = qos.PRIORITY_BACKGROUND
             qctx = qos.QosContext(
@@ -681,6 +696,106 @@ class FiloHttpServer:
                                   str(body.get("owner") or ""))
             return 200, {"status": "success", "data": out}
         return 404, prom_json.error(f"no route for {path}", "not_found")
+
+    # -- recording rules & alerting (filodb_tpu/rules) --------------------
+    def _rules_proxy(self, path: str, qs: Dict):
+        """Under the supervisor only ONE worker evaluates rules; a
+        request landing on a stand-by worker (the kernel balances the
+        public port) proxies to the evaluator's private port so clients
+        see authoritative state regardless of which worker accepted.
+        ``__local__`` breaks proxy loops when elections disagree for a
+        beat. Returns None when no proxy applies (answer locally)."""
+        eng = self.rules
+        if eng is None or qs.get("__local__"):
+            return None
+        snap = eng.snapshot()
+        if snap["active"]:
+            return None
+        target = self.peers.get(f"node{eng.evaluator_ordinal()}")
+        if not target:
+            return None
+        import urllib.request as ureq
+        q = {k: v for k, v in qs.items()}
+        q["__local__"] = ["1"]
+        url = (target.rstrip("/") + path + "?"
+               + urllib.parse.urlencode(q, doseq=True))
+        try:
+            with ureq.urlopen(url, timeout=5) as r:
+                return 200, json.loads(r.read())
+        except (OSError, ValueError):
+            return None     # fall back to the local (stand-by) view
+
+    def _rules_api(self, qs: Dict):
+        """GET /api/v1/rules (Prometheus rules API shape). Extensions:
+        ``&explain=analyze`` inlines each rule's retained last
+        evaluation (query, exact range, cache dispositions, duration,
+        error) — the rules engine's own &explain surface."""
+        proxied = self._rules_proxy("/api/v1/rules", qs)
+        if proxied is not None:
+            return proxied
+        eng = self.rules
+        if eng is None:
+            return 200, {"status": "success",
+                         "data": {"groups": [], "evaluating": False}}
+        explain = self._param(qs, "explain") == "analyze"
+        data = eng.rules_payload(explain=explain)
+        if self._param(qs, "debug"):
+            # scheduler/election introspection (the failover audit
+            # trail): alive set, announce state, election-event ring
+            data["debug"] = eng.snapshot()
+        return 200, {"status": "success", "data": data}
+
+    def _alerts_api(self, qs: Dict):
+        """GET /api/v1/alerts: active alert instances + the bounded
+        structured-event ring of state transitions."""
+        proxied = self._rules_proxy("/api/v1/alerts", qs)
+        if proxied is not None:
+            return proxied
+        eng = self.rules
+        if eng is None:
+            return 200, {"status": "success", "data": {"alerts": []}}
+        return 200, {"status": "success", "data": eng.alerts_payload()}
+
+    def rule_eval_range(self, ds: str, query: str, plan,
+                        start_ms: int, step_ms: int, end_ms: int):
+        """One standing-query evaluation for the rules engine, through
+        the NORMAL serving path: plan-cost charge (FORCED, on the
+        reserved ``__rules__`` tenant — standing evaluation never
+        bounces off a drained bucket), results-cache split (the tick is
+        a step-aligned tail recompute: the warm prefix serves from
+        cache, only the newest step materializes), engine execution at
+        BACKGROUND priority. Returns ``(result, stages)``; the stages
+        dict carries the cache dispositions the engine retains per rule
+        for ``/api/v1/rules?explain=analyze``. No admission slot is
+        taken: the scheduler is a single standing consumer, not a burst
+        of client connections."""
+        deadline = Deadline.after(self.query_timeout_s)
+        engine = self.make_planner(ds, deadline=deadline)
+        if engine is None:
+            raise QueryError(f"rules: dataset {ds} not set up")
+        stages: Dict[str, object] = {}
+        qctx = qos.QosContext(tenant=qos.RULES_TENANT,
+                              priority=qos.PRIORITY_BACKGROUND,
+                              forced=True)
+        with qos.activate(qctx):
+            with obs_trace.span("rule-eval", query=query, dataset=ds):
+                # forced context: charges the reserved tenant's bucket
+                # and returns None — rule evaluation is never shed
+                self._charge_or_shed(engine, {}, ds, query, plan,
+                                     start_ms // 1000, end_ms // 1000,
+                                     step_ms // 1000, stages)
+                ses = self.result_cache.begin(
+                    engine, ds, query, plan, start_ms, step_ms, end_ms)
+                exs = [engine.materialize(p) for p in ses.plans]
+                res = ses.finish(engine,
+                                 [ex.execute() for ex in exs])
+        stages["resultCache"] = ses.state
+        stages["cachedSteps"] = ses.cached_steps
+        if isinstance(res, GridResult):
+            stages["series"] = res.num_series
+            if res.partial:
+                stages["partial"] = True
+        return res, stages
 
     def _local_shard_nums(self, ds: str) -> set:
         return {getattr(s, "shard_num", i)
@@ -1080,14 +1195,14 @@ class FiloHttpServer:
         shards = self.shards_by_dataset.get(ds)
         if shards is None:
             return None
-        if ds == SELFMON_DATASET:
-            # the reserved internal dataset is strictly node-local: its
-            # shard numbers are worker ordinals outside the user
-            # dataset's mapper world, every process serves only its own
-            # internal series (stamped with a worker label), and
-            # self-queries must never fan out, push down, or ride the
-            # mesh. A minimal planner over the local shard(s) keeps the
-            # whole cluster plane out of the loop — and out of its
+        if ds in INTERNAL_DATASETS:
+            # a reserved internal dataset (self-telemetry / rule
+            # outputs) is strictly node-local: its shard numbers are
+            # worker ordinals outside the user dataset's mapper world,
+            # every process serves only its own internal series, and
+            # internal queries must never fan out, push down, or ride
+            # the mesh. A minimal planner over the local shard(s) keeps
+            # the whole cluster plane out of the loop — and out of its
             # failure domain.
             planner = QueryPlanner(
                 shards, backend=self.backend, deadline=deadline,

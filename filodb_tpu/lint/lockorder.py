@@ -58,6 +58,12 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
     "CircuitBreaker._lock",
     "BatchStats._lock",
     "SplitResult._lock",
+    # rules engine (filodb_tpu/rules): scheduler/election/alert state.
+    # Evaluations and write-backs run strictly OUTSIDE it; while held
+    # it only touches registry family leaves (below), so it sits above
+    # the observability leaves and below every serving-path lock.
+    "RulesEngine._lock",
+    "WebhookNotifier._lock",
     # observability leaves: the self-monitor's tick counters, the
     # device profiler's executable table (compiles run OUTSIDE it),
     # and the metric registry's family maps (collect_into snapshots

@@ -49,31 +49,23 @@ import threading
 import time
 from typing import Dict, Optional
 
-from filodb_tpu.core.record import RecordBuilder
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.obs.writeback import (COUNTER_SUFFIXES, IngestWriteBack,
+                                      schema_for_sample)
 
 # reserved identifiers: the internal dataset name doubles as the
 # reserved tenant (workspace) internal series are tagged with
 SELFMON_DATASET = "__selfmon__"
 SELFMON_TENANT = "__selfmon__"
 
-# sample-name suffixes that are cumulative (monotone) series: they
-# ingest under the counter schema so rate()/increase() get counter
-# semantics (reset correction) — everything else is a gauge snapshot
-_COUNTER_SUFFIXES = ("_total", "_bucket", "_count", "_sum")
+# back-compat aliases: the schema heuristic moved to obs/writeback.py
+# (the factored write-back rail shared with the rules engine)
+_COUNTER_SUFFIXES = COUNTER_SUFFIXES
+_schema_for = schema_for_sample
 
 _TICK_HELP = "Wall seconds per self-monitoring collect+ingest tick"
-
-
-def _schema_for(family_type: str, sample_name: str) -> str:
-    if family_type == "counter":
-        return "prom-counter"
-    if family_type == "histogram" or sample_name.endswith(
-            _COUNTER_SUFFIXES):
-        return "prom-counter"
-    return "gauge"
 
 
 @guarded_by("_lock", "ticks", "samples_ingested", "series_last_tick",
@@ -97,6 +89,10 @@ class SelfMonitor:
         self.shard = shard
         self.schemas = schemas or DEFAULT_SCHEMAS
         self.stream = stream
+        # the factored write-back rail (obs/writeback.py): this loop is
+        # its single writer; the rules engine drives its own instance
+        self.writeback = IngestWriteBack(shard, schemas=self.schemas,
+                                         stream=stream)
         self.interval_s = float(interval_s)
         self.node = node or ""
         self.worker_id = worker_id
@@ -172,8 +168,7 @@ class SelfMonitor:
         if now_ms is None:
             now_ms = int(time.time() * 1000)
         builder = self.exposition_source()
-        rb = RecordBuilder(self.schemas)
-        n = 0
+        out = []
         series: set = set()
         for fam, mtype, _help, samples in builder.families():
             for name, labels_tuple, value in samples:
@@ -192,17 +187,10 @@ class SelfMonitor:
                         labels[k] = lv
                 if self.worker_id is not None:
                     labels.setdefault("worker", str(self.worker_id))
-                rb.add_sample(_schema_for(mtype, name), labels,
-                              now_ms, v)
+                out.append((schema_for_sample(mtype, name), labels,
+                            now_ms, v))
                 series.add((name, labels_tuple))
-                n += 1
-        for cont in rb.containers():
-            if self.stream is not None:
-                # durable WAL first; the ingestion driver replays it
-                # into the memstore (recovery-safe, group-commit fsync)
-                self.stream.append(cont)
-            else:
-                self.shard.ingest(cont)
+        n = self.writeback.write(out)
         with self._lock:
             self.ticks += 1
             self.samples_ingested += n
@@ -213,7 +201,7 @@ class SelfMonitor:
         if self.stream is None and ticks % self.flush_every_ticks == 0:
             # direct-ingest mode: flush so the ingest watermark (the
             # results cache's freshness input) advances like any shard
-            self.shard.flush_all()
+            self.writeback.flush()
         self._m_ticks.inc()
         self._m_samples.inc(n)
         self._m_series.set(len(series))

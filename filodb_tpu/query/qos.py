@@ -58,15 +58,16 @@ DEFAULT_TENANT = "default"
 TENANT_HEADER = "X-Filo-Tenant"
 PRIORITY_HEADER = "X-Filo-Priority"
 
-# reserved internal tenant (obs/selfmon.py): self-telemetry and the
-# standing background workloads riding it (recording rules, the
-# self-monitor loop's own queries) run at the BACKGROUND priority
-# class and charge FORCED like fan-out legs — internal series must
-# never bounce off a drained admission bucket, and must never crowd
-# out interactive user queries. Not a bypass a user should borrow:
-# forced charges still land on the tenant's bucket (driving it into
-# debt), they just never shed.
+# reserved internal tenants: self-telemetry (obs/selfmon.py) and the
+# recording-rules engine (filodb_tpu/rules) run at the BACKGROUND
+# priority class and charge FORCED like fan-out legs — standing
+# background evaluation must never bounce off a drained admission
+# bucket, and must never crowd out interactive user queries. Not a
+# bypass a user should borrow: forced charges still land on the
+# tenant's bucket (driving it into debt), they just never shed.
 SELFMON_TENANT = "__selfmon__"
+RULES_TENANT = "__rules__"
+INTERNAL_TENANTS = frozenset({SELFMON_TENANT, RULES_TENANT})
 
 # priority classes, lower = sooner. Interactive is the default for
 # client traffic; rules/background is for standing evaluation and

@@ -122,6 +122,27 @@ DEFAULTS = {
     # internal shard's ingest watermark — the results cache's
     # freshness input — advances on flush)
     "self-monitor-flush-ticks": 4,
+    # -- recording rules & alerting (filodb_tpu/rules) ----------------
+    # rules-file: a Prometheus-style YAML/JSON rule-group file;
+    # "rules" accepts the same structure inline ({"groups": [...]}) —
+    # handy for tests and generated configs. Groups evaluate in-process
+    # as standing queries (background priority, forced-charge
+    # __rules__ tenant, step-aligned tail recomputes through the
+    # results cache); recorded series + synthetic ALERTS land in the
+    # reserved __rules__ dataset via the selfmon write-back rail
+    # (durable WAL + driver replay under stream-dir). Under the
+    # supervisor every worker loads the config but only the lowest
+    # ALIVE ordinal evaluates (re-elected on bus worker-exit).
+    "rules-file": None,
+    "rules": None,
+    # steps per evaluation window: each tick queries the last N
+    # interval-aligned steps so the results cache serves the warm
+    # prefix and only the newest step recomputes
+    "rules-eval-span-steps": 8,
+    # alert webhook receiver (Alertmanager-webhook-shaped POSTs,
+    # retried with backoff through a per-receiver circuit breaker);
+    # None = no notifications
+    "rules-webhook-url": None,
     # group-commit fsync for the durable ingest streams (ROADMAP
     # follow-up: per-append fsync stalls on shared container disks).
     # Appends fsync at most every this-many ms (or 1MB unsynced);
@@ -312,6 +333,12 @@ class FiloServer:
         self.selfmon = None
         self._selfmon_stream = None
         self._selfmon_driver = None
+        # recording rules & alerting (filodb_tpu/rules): engine +
+        # the reserved __rules__ dataset's stream/driver (None when no
+        # rules are configured)
+        self.rules = None
+        self._rules_stream = None
+        self._rules_driver = None
 
     def _make_qos_budgets(self):
         """Per-tenant token-bucket budgets from the qos-* knobs (None
@@ -666,6 +693,8 @@ class FiloServer:
             self._start_ingestion()
         if self.config.get("self-monitor"):
             self._start_selfmon()
+        if self.config.get("rules") or self.config.get("rules-file"):
+            self._start_rules()
         # serving-path GC hygiene: move the (large, permanent) startup
         # object graph out of the collector's reach and make full
         # collections 10x rarer — a gen-2 sweep over jax/XLA module
@@ -736,15 +765,31 @@ class FiloServer:
             "topo_epoch": int(ev.get("topo_epoch") or 0),
         }
 
+    @staticmethod
+    def _worker_ordinal(node: str) -> Optional[int]:
+        try:
+            return int(node.removeprefix("node"))
+        except ValueError:
+            return None
+
     def _bus_apply_worker_exit(self, ev: Dict) -> None:
         node = str(ev.get("node") or "")
         if self.detector is not None and node:
             self.detector.note_peer_exit(node)
+        # single-owner rule scheduling: a dead sibling triggers
+        # re-election (the next-lowest ALIVE ordinal takes over at the
+        # next interval boundary — no duplicated tick by construction)
+        ordinal = self._worker_ordinal(node)
+        if self.rules is not None and ordinal is not None:
+            self.rules.note_worker_exit(ordinal)
 
     def _bus_apply_worker_up(self, ev: Dict) -> None:
         node = str(ev.get("node") or "")
         if self.detector is not None and node:
             self.detector.note_peer_up(node)
+        ordinal = self._worker_ordinal(node)
+        if self.rules is not None and ordinal is not None:
+            self.rules.note_worker_up(ordinal)
 
     def _bus_gossip_once(self) -> None:
         """One watermark/backfill gossip beat onto the bus (the same
@@ -817,54 +862,63 @@ class FiloServer:
                 spread_provider=self.spread_provider,
                 port=int(self.config["gateway-port"])).start()
 
-    # -- self-monitoring (obs/selfmon.py) ---------------------------------
-    def _start_selfmon(self) -> None:
-        """Wire the reserved internal dataset and start the loop.
-
-        One internal shard per process, numbered by worker ordinal so
-        a supervisor fleet sharing data/stream dirs never collides:
-        worker k's internal series live in shard k of ``__selfmon__``
-        (stamped with a ``worker`` label), each worker serves its own
-        via a strictly-local planner. The shard gets its OWN
+    # -- reserved internal datasets (selfmon + rules write-back) ----------
+    def _setup_internal_dataset(self, dataset: str, subdir: str):
+        """One internal shard per process for a reserved dataset,
+        numbered by worker ordinal so a supervisor fleet sharing
+        data/stream dirs never collides. The shard gets its OWN
         CardinalityTracker — internal series are invisible to user
-        cardinality accounting and quotas. With a stream-dir the loop
-        appends to a dedicated WAL and a normal IngestionDriver
-        replays it (recovery included: self-telemetry survives worker
-        restarts); memory-only deployments ingest directly and flush
-        on a tick cadence so the freshness watermark still advances."""
+        cardinality accounting and quotas. With a stream-dir the
+        producer appends to a dedicated WAL and a normal
+        IngestionDriver replays it (recovery included: derived series
+        survive restarts); memory-only deployments ingest directly and
+        flush explicitly so the freshness watermark still advances.
+        Returns ``(shard, stream, driver)`` (stream/driver None without
+        a stream-dir)."""
         import os
 
         from filodb_tpu.core.cardinality import CardinalityTracker
-        from filodb_tpu.obs.selfmon import SELFMON_DATASET, SelfMonitor
         wid = self.config.get("worker-id")
         shard_num = int(wid or 0)
-        ref = DatasetRef(SELFMON_DATASET)
+        ref = DatasetRef(dataset)
         shard = self.store.setup(
             ref, shard_num,
             num_groups=2,
             max_chunk_rows=self.config["max-chunks-size"],
             bootstrap=self.store.column_store is not None,
             card_tracker=CardinalityTracker())
-        self.http.shards_by_dataset[SELFMON_DATASET] = \
-            self.store.shards(ref)
-        stream = None
+        self.http.shards_by_dataset[dataset] = self.store.shards(ref)
+        stream = driver = None
         if self.config.get("stream-dir"):
-            from filodb_tpu.ingest import LogIngestionStream
-            path = os.path.join(self.config["stream-dir"], "selfmon",
+            from filodb_tpu.ingest import (IngestionDriver,
+                                           LogIngestionStream)
+            path = os.path.join(self.config["stream-dir"], subdir,
                                 f"shard={shard_num}", "stream.log")
             stream = LogIngestionStream(
                 path, DEFAULT_SCHEMAS,
                 group_commit_s=float(self.config.get(
                     "stream-group-commit-ms", 0)) / 1000)
-            self._selfmon_stream = stream
-            from filodb_tpu.ingest import IngestionDriver
-            self._selfmon_driver = IngestionDriver(
+            driver = IngestionDriver(
                 shard, stream, mapper=None,
                 flush_interval_s=float(self.config.get(
                     "flush-interval-s", 2.0)),
                 ingest_batch_records=int(self.config.get(
                     "ingest-batch-records", 64)))
-            self._selfmon_driver.start()
+            driver.start()
+        return shard, stream, driver
+
+    # -- self-monitoring (obs/selfmon.py) ---------------------------------
+    def _start_selfmon(self) -> None:
+        """Wire the reserved ``__selfmon__`` dataset and start the
+        loop (see _setup_internal_dataset for the shard/WAL model;
+        internal series are stamped with a ``worker`` label and each
+        worker serves its own via a strictly-local planner)."""
+        from filodb_tpu.obs.selfmon import SELFMON_DATASET, SelfMonitor
+        wid = self.config.get("worker-id")
+        shard, stream, driver = self._setup_internal_dataset(
+            SELFMON_DATASET, "selfmon")
+        self._selfmon_stream = stream
+        self._selfmon_driver = driver
         self.selfmon = SelfMonitor(
             self.http.build_exposition, shard,
             schemas=DEFAULT_SCHEMAS, stream=stream,
@@ -876,6 +930,61 @@ class FiloServer:
                 "self-monitor-flush-ticks", 4)))
         self.http.selfmon = self.selfmon
         self.selfmon.start()
+
+    # -- recording rules & alerting (filodb_tpu/rules) --------------------
+    def _start_rules(self) -> None:
+        """Load the rule groups and start the scheduler.
+
+        Evaluations run through ``FiloHttpServer.rule_eval_range`` —
+        the normal plan-cache/results-cache/QoS path under the reserved
+        ``__rules__`` tenant; recorded series and ALERTS state series
+        write back through the shared IngestWriteBack rail into the
+        reserved ``__rules__`` dataset (same shard/WAL model as
+        selfmon). Under the supervisor every worker builds the engine
+        from the propagated config, but only the lowest ALIVE worker
+        ordinal evaluates; the bus ``worker-exit``/``worker-up``
+        lifecycle events re-elect (wired in the bus handlers above)."""
+        from filodb_tpu.obs.writeback import IngestWriteBack
+        from filodb_tpu.rules import (RULES_DATASET, RulesEngine,
+                                      WebhookNotifier, load_groups,
+                                      load_rules_file)
+        if self.config.get("rules"):
+            groups = load_groups(self.config["rules"])
+        else:
+            groups = load_rules_file(self.config["rules-file"])
+        if not groups:
+            return
+        shard, stream, driver = self._setup_internal_dataset(
+            RULES_DATASET, "rules")
+        self._rules_stream = stream
+        self._rules_driver = driver
+        notifier = None
+        url = self.config.get("rules-webhook-url")
+        if url:
+            notifier = WebhookNotifier(url).start()
+        wid = self.config.get("worker-id")
+        self.rules = RulesEngine(
+            groups,
+            evaluator=self.http.rule_eval_range,
+            writeback=IngestWriteBack(shard, schemas=DEFAULT_SCHEMAS,
+                                      stream=stream),
+            default_dataset=self.config["dataset"],
+            node=self.node_id,
+            worker_id=int(wid) if wid is not None else None,
+            num_workers=int(self.config.get("num-nodes", 1) or 1),
+            span_steps=int(self.config.get("rules-eval-span-steps", 8)),
+            notifier=notifier,
+            # supervised workers stand by until their own worker-up
+            # broadcast (single-owner handover in one bus beat);
+            # bus-less processes are announced from birth
+            announced=not self.config.get("bus-port"))
+        self.http.rules = self.rules
+        # topology/schema invalidations reach the engine's rule-plan
+        # cache through the plan cache's listener chain (the same chain
+        # the results cache rides) — see the @cache_registry inventory
+        self.http.plan_cache.add_invalidation_listener(
+            self.rules.invalidate_plans)
+        self.rules.start()
 
     # -- elastic recovery (shard reassignment on node loss) ---------------
     # ShardManager.scala:28 assignShardsToNodes / IngestionActor.scala:297
@@ -1113,6 +1222,15 @@ class FiloServer:
         return rows
 
     def stop(self) -> None:
+        if self.rules is not None:
+            self.rules.stop()
+        if self._rules_driver is not None:
+            self._rules_driver.stop()
+        if self._rules_stream is not None:
+            try:
+                self._rules_stream.close()
+            except OSError:
+                pass
         if self.selfmon is not None:
             self.selfmon.stop()
         if self._selfmon_driver is not None:
@@ -1176,6 +1294,10 @@ def main(argv=None) -> int:
                    help="ingest this node's own metrics into the "
                         "reserved __selfmon__ dataset (PromQL over "
                         "our own telemetry)")
+    p.add_argument("--rules-file",
+                   help="Prometheus-style recording/alerting rule "
+                        "file evaluated in-process (validate with "
+                        "python -m filodb_tpu.rules --check)")
     p.add_argument("--seed-dev-data", action="store_true",
                    help="generate dev series on startup")
     args = p.parse_args(argv)
@@ -1184,7 +1306,7 @@ def main(argv=None) -> int:
         with open(args.config) as f:
             config.update(json.load(f))
     for k in ("port", "num_shards", "dataset", "data_dir", "stream_dir",
-              "gateway_port", "self_monitor"):
+              "gateway_port", "self_monitor", "rules_file"):
         v = getattr(args, k)
         if v is not None:
             config[k.replace("_", "-")] = v

@@ -1,0 +1,238 @@
+"""Chaos for the rules engine: evaluation keeps running under node
+loss and sustained tenant brownout (tests/test_chaos_qos.py-style
+load). Pins: no crash, the staleness metric rises while evaluations
+fail, alerts do NOT flap to inactive on evaluation errors, the forced-
+charge __rules__ tenant keeps evaluating while the overloaded default
+tenant bounces, and recording resumes cleanly after recovery.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+from filodb_tpu.query import qos
+from filodb_tpu.standalone.server import FiloServer
+from filodb_tpu.testing import chaos
+
+T0 = 1_600_000_000
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_raw(port, path, params, timeout=30):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}?{qs}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _poll(fn, timeout=30.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        ok, last = fn()
+        if ok:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}: {last!r}")
+
+
+@pytest.fixture()
+def cluster():
+    """Two in-process nodes; node0 runs the rules engine over a
+    fan-out expression (its evaluation NEEDS node1), with tiny budgets
+    for every ordinary tenant so sustained load browns the edge out."""
+    p0, p1 = _free_port(), _free_port()
+    peers = {"node0": f"http://127.0.0.1:{p0}",
+             "node1": f"http://127.0.0.1:{p1}"}
+    base = {
+        "num-shards": 4, "num-nodes": 2, "peers": peers,
+        "failure-detect-interval-s": 300.0,   # detection never reacts
+        "grpc-port": None,
+        "query-timeout-s": 5.0,
+        "peer-retry-attempts": 1,             # rule evals fail fast
+        "peer-retry-base-delay-s": 0.01,
+        "breaker-failure-threshold": 1_000_000,
+        "max-inflight-queries": 16,
+        "admission-wait-s": 2.0,
+        # every unprivileged tenant is budgeted tiny: a handful of
+        # real queries drains the default bucket (the brownout)
+        "qos-tenant-rate": 2, "qos-tenant-burst": 50,
+        "qos-shed-degraded": False,
+    }
+    a = FiloServer({
+        **base, "node-ordinal": 0, "port": p0,
+        "rules-eval-span-steps": 4,
+        "rules": {"groups": [{
+            "name": "chaos", "interval": "0.5s", "rules": [
+                {"record": "chaos:sig:sum", "expr": "sum(chaos_sig)"},
+                {"alert": "ChaosData", "expr": "sum(chaos_sig) > 0",
+                 "labels": {"severity": "page"}},
+            ]}]},
+    }).start()
+    b = FiloServer({**base, "node-ordinal": 1, "port": p1}).start()
+
+    # the signal series lives on BOTH nodes' shards (rule evaluation
+    # fans out), one writer thread per node at wall-now
+    stop = threading.Event()
+
+    def writer(srv, shard):
+        while not stop.is_set():
+            rb = RecordBuilder(DEFAULT_SCHEMAS)
+            rb.add_sample("gauge", {"_metric_": "chaos_sig",
+                                    "shard": str(shard)},
+                          int(time.time() * 1000), 1.0)
+            for c in rb.containers():
+                srv.store.ingest(srv.ref, shard, c)
+            time.sleep(0.1)
+    threads = [threading.Thread(target=writer, args=(a, 0), daemon=True),
+               threading.Thread(target=writer, args=(b, 2), daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        yield a, b
+    finally:
+        chaos.uninstall()
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        for srv in (a, b):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+
+
+def _rule_states(srv):
+    payload = srv.rules.rules_payload()
+    return {r["name"]: r for g in payload["groups"]
+            for r in g["rules"]}
+
+
+def _staleness(srv, group="chaos"):
+    text = srv.http.build_exposition().render()
+    for ln in text.splitlines():
+        if ln.startswith("filodb_rule_group_staleness_seconds") \
+                and f'group="{group}"' in ln:
+            return float(ln.rsplit(" ", 1)[1])
+    return None
+
+
+def test_rules_survive_node_loss_and_brownout(cluster):
+    a, _b = cluster
+
+    # -- phase 0: healthy — recording + alert firing -------------------
+    def _healthy():
+        states = _rule_states(a)
+        rec = states.get("chaos:sig:sum", {})
+        al = states.get("ChaosData", {})
+        return (rec.get("health") == "ok"
+                and al.get("state") == "firing"), (rec, al)
+    _poll(_healthy, msg="healthy rules baseline")
+    ticks0 = a.rules.snapshot()["ticks"]
+
+    # -- phase 1: sustained brownout (chaos_qos-style load) ------------
+    # the default tenant hammers the edge until its bucket drains and
+    # it starts bouncing with 429; the forced-charge __rules__ tenant
+    # must keep evaluating through it
+    q = {"query": "sum(rate(http_requests_total[5m])) or sum(chaos_sig)",
+         "start": int(time.time()) - 600, "end": int(time.time()),
+         "step": 5, "cache": "false"}
+    stop = threading.Event()
+    codes = []
+
+    def abuse():
+        while not stop.is_set():
+            code, _ = _get_raw(
+                a.port, "/promql/timeseries/api/v1/query_range", q)
+            codes.append(code)
+    t = threading.Thread(target=abuse, daemon=True)
+    t.start()
+    time.sleep(2.5)
+    stop.set()
+    t.join(timeout=10)
+    assert 429 in codes, f"brownout never tripped: {codes[:10]}"
+    snap = a.rules.snapshot()
+    assert snap["ticks"] > ticks0 + 2, "rules stalled under brownout"
+    states = _rule_states(a)
+    assert states["ChaosData"]["state"] == "firing"
+    assert states["chaos:sig:sum"]["health"] == "ok"
+    # the reserved tenant charged FORCED (possibly into debt), never
+    # bounced
+    bucket = a.http.admission.budgets.bucket(qos.RULES_TENANT)
+    assert bucket is not None and bucket.forced_charges > 0
+
+    # -- phase 2: node loss — evaluations fail, nothing flaps ----------
+    inj = chaos.ChaosInjector()
+    inj.fail("http.peer", match=lambda c: c.get("node") == "node1")
+    chaos.install(inj)
+    try:
+        def _failing():
+            states = _rule_states(a)
+            stale = _staleness(a)
+            return (states["chaos:sig:sum"]["health"] == "err"
+                    and stale is not None and stale > 1.0), \
+                (states["chaos:sig:sum"]["health"], stale)
+        _poll(_failing, timeout=20, msg="eval failures + staleness rise")
+        # the alert did NOT flap to inactive on evaluation errors
+        states = _rule_states(a)
+        assert states["ChaosData"]["state"] == "firing"
+        assert "injected" in states["chaos:sig:sum"]["lastError"] \
+            or states["chaos:sig:sum"]["lastError"]
+        # the scheduler is alive and still ticking (failures counted,
+        # loop never died)
+        t1 = a.rules.snapshot()["ticks"]
+        time.sleep(1.2)
+        assert a.rules.snapshot()["ticks"] > t1
+        fails = {tuple(sorted(lbl.items())): v
+                 for lbl, v in a.rules._m_failures.series()}
+        assert fails.get((("group", "chaos"),
+                          ("rule", "chaos:sig:sum")), 0) >= 1
+    finally:
+        chaos.uninstall()
+
+    # -- phase 3: recovery — health returns, staleness falls -----------
+    def _recovered():
+        states = _rule_states(a)
+        stale = _staleness(a)
+        return (states["chaos:sig:sum"]["health"] == "ok"
+                and stale is not None and stale < 1.5), \
+            (states["chaos:sig:sum"]["health"], stale)
+    _poll(_recovered, timeout=20, msg="recovery")
+    # the alert never left firing across the whole scenario
+    walk = [(t["from"], t["to"])
+            for t in a.rules.alerts_payload()["transitions"]
+            if t["alert"] == "ChaosData"]
+    assert walk == [("inactive", "firing")]
+
+    # recording resumed: fresh samples keep landing after recovery
+    (rec_shard,) = [s for s in
+                    a.http.shards_by_dataset["__rules__"]]
+    from filodb_tpu.core.index import ColumnFilter
+    def _fresh_sample():
+        parts = rec_shard.lookup_partitions(
+            [ColumnFilter("_metric_", "eq", "chaos:sig:sum")],
+            0, 1 << 62)
+        if not parts:
+            return False, None
+        wm = rec_shard.ingest_watermark_ms
+        return (wm is not None
+                and wm > (time.time() - 3.0) * 1000), wm
+    _poll(_fresh_sample, timeout=15, msg="post-recovery recording")

@@ -1,0 +1,525 @@
+"""Recording rules & alerting (filodb_tpu/rules): loader/validator
+units, the alert state machine under a deterministic clock, single-owner
+election, the rule-plan cache's invalidation hook, the factored
+write-back rail, and the shipped example file's tier-1 validation gate.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.obs.writeback import (IngestWriteBack, schema_for_sample)
+from filodb_tpu.query.model import GridResult
+from filodb_tpu.rules import (RULES_DATASET, Rule, RuleGroup,
+                              RuleLoadError, RulesEngine, WebhookNotifier,
+                              check_rules_file, load_groups,
+                              parse_rules_text)
+from filodb_tpu.rules.engine import (_render_template, STATE_FIRING,
+                                     STATE_PENDING)
+
+T0 = 1_600_000_000
+
+
+# ---------------------------------------------------------------------------
+# loader / validator
+# ---------------------------------------------------------------------------
+
+def test_load_groups_full_shape():
+    groups = load_groups({"groups": [
+        {"name": "g", "interval": "30s", "dataset": "ds1", "rules": [
+            {"record": "a:rate5m", "expr": "rate(x_total[5m])",
+             "labels": {"tier": "gold"}},
+            {"alert": "Hot", "expr": "rate(x_total[5m]) > 1",
+             "for": "2m", "labels": {"severity": "page"},
+             "annotations": {"summary": "hot: {{ $value }}"}},
+        ]},
+    ]})
+    (g,) = groups
+    assert g.name == "g" and g.interval_s == 30.0 and g.dataset == "ds1"
+    rec, al = g.rules
+    assert rec.kind == "recording" and rec.labels == (("tier", "gold"),)
+    assert al.is_alert and al.for_s == 120.0
+    assert dict(al.annotations)["summary"] == "hot: {{ $value }}"
+
+
+def test_load_groups_validation_errors():
+    errors = []
+    load_groups({"groups": [
+        {"name": "g", "interval": "30s", "rules": [
+            {"record": "ok:one", "expr": "sum(x)"},
+            {"record": "bad name!", "expr": "sum(x)"},
+            {"record": "syntax", "expr": "rate(x_total[5m"},
+            {"alert": "A", "expr": "x > 1", "schema": "counter"},
+            {"record": "r2", "expr": "sum(x)", "for": "1m"},
+            {"expr": "sum(x)"},
+            {"record": "both", "alert": "both", "expr": "sum(x)"},
+        ]},
+        {"name": "g", "rules": [{"record": "ok:two", "expr": "x"}]},
+    ]}, errors=errors)
+    text = "\n".join(errors)
+    assert "invalid metric name" in text
+    assert "PromQL syntax error" in text
+    assert "schema: is recording-only" in text
+    assert "for: is alert-only" in text
+    assert "exactly one of record:/alert: required" in text
+    assert "duplicate group name" in text
+
+
+def test_duplicate_rule_detection_across_groups():
+    errors = []
+    load_groups({"groups": [
+        {"name": "g1", "rules": [
+            {"record": "dup:rule", "expr": "sum(x)"}]},
+        {"name": "g2", "rules": [
+            {"record": "dup:rule", "expr": "sum(y)"}]},
+    ]}, errors=errors)
+    assert any("duplicate rule" in e for e in errors)
+    # same name with DIFFERENT labels is legal (distinct series)
+    groups = load_groups({"groups": [
+        {"name": "g1", "rules": [
+            {"record": "dup:rule", "expr": "sum(x)",
+             "labels": {"a": "1"}}]},
+        {"name": "g2", "rules": [
+            {"record": "dup:rule", "expr": "sum(y)",
+             "labels": {"a": "2"}}]},
+    ]})
+    assert len(groups) == 2
+
+
+def test_parse_rules_text_yaml_and_json():
+    yaml_text = ("groups:\n- name: g\n  interval: 15s\n  rules:\n"
+                 "  - record: a:b\n    expr: sum(x)\n")
+    json_text = ('{"groups": [{"name": "g", "interval": 15, "rules": '
+                 '[{"record": "a:b", "expr": "sum(x)"}]}]}')
+    for text in (yaml_text, json_text):
+        (g,) = parse_rules_text(text)
+        assert g.interval_s == 15.0 and g.rules[0].name == "a:b"
+    with pytest.raises(RuleLoadError):
+        parse_rules_text('{"groups": []}')
+
+
+def test_shipped_example_file_is_clean():
+    """Tier-1 gate for the shipped example: the file every README
+    snippet points at must validate with the promtool-style checker."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "rules.yaml")
+    assert check_rules_file(path) == []
+
+
+def test_check_cli_exit_codes(tmp_path):
+    from filodb_tpu.rules.__main__ import main
+    good = tmp_path / "good.json"
+    good.write_text('{"groups": [{"name": "g", "rules": '
+                    '[{"record": "a:b", "expr": "sum(x)"}]}]}')
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"groups": [{"name": "g", "rules": '
+                   '[{"record": "a:b", "expr": "rate(x["}]}]}')
+    assert main(["--check", str(good)]) == 0
+    assert main(["--check", str(bad)]) == 1
+    assert main(["--check", str(tmp_path / "missing.json")]) == 1
+
+
+def test_render_template():
+    assert _render_template("v={{ $value }} on {{ $labels.instance }}",
+                            1.5, {"instance": "i0"}) == "v=1.5 on i0"
+    assert _render_template("plain", 1.0, {}) == "plain"
+    assert _render_template("{{ $labels.missing }}!", None, {}) == "!"
+
+
+# ---------------------------------------------------------------------------
+# engine under a deterministic clock (fake evaluator)
+# ---------------------------------------------------------------------------
+
+class _FakeEvaluator:
+    """Scripted evaluator: maps rule expr -> list of (labels, value)
+    series for the LAST step; records every call."""
+
+    def __init__(self):
+        self.series = {}
+        self.calls = []
+        self.raise_for = set()
+
+    def __call__(self, ds, query, plan, start_ms, step_ms, end_ms):
+        self.calls.append((ds, query, start_ms, step_ms, end_ms))
+        if query in self.raise_for:
+            raise RuntimeError("injected eval failure")
+        rows = self.series.get(query, [])
+        steps = np.arange(start_ms, end_ms + 1, step_ms, dtype=np.int64)
+        keys = [dict(labels) for labels, _v in rows]
+        values = np.full((len(rows), steps.size), np.nan)
+        for i, (_l, v) in enumerate(rows):
+            values[i, :] = v
+        return (GridResult(steps, keys, values),
+                {"resultCache": "partial", "cachedSteps": steps.size - 1})
+
+
+def _mk_engine(groups, evaluator=None, clock=None, **kw):
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    shard = store.setup(DatasetRef(RULES_DATASET), 0, num_groups=2)
+    ev = evaluator or _FakeEvaluator()
+    eng = RulesEngine(groups, evaluator=ev,
+                      writeback=IngestWriteBack(shard),
+                      default_dataset="ts", node="n0",
+                      clock=clock or time.time, **kw)
+    return eng, ev, shard
+
+
+def _lookup(shard, metric):
+    from filodb_tpu.core.index import ColumnFilter
+    return shard.lookup_partitions(
+        [ColumnFilter("_metric_", "eq", metric)], 0, 1 << 62)
+
+
+def test_recording_rule_writes_back_with_schema():
+    g = RuleGroup("g", 10.0, (
+        Rule("job:x:rate", "rate(x_total[1m])", "recording",
+             labels=(("tier", "gold"),)),
+        Rule("job:x:events_total", "sum(increase(x_total[1m]))",
+             "recording", schema="counter"),
+    ))
+    eng, ev, shard = _mk_engine([g])
+    ev.series["rate(x_total[1m])"] = [({"instance": "i0"}, 1.5),
+                                      ({"instance": "i1"}, 2.5)]
+    ev.series["sum(increase(x_total[1m]))"] = [({}, 60.0)]
+    out = eng.eval_group_once(g, T0 + 100)
+    assert out["ok"] and out["samples"] == 3
+    parts = _lookup(shard, "job:x:rate")
+    labelled = {dict(p.part_key.labels)["instance"]: p for p in parts}
+    assert set(labelled) == {"i0", "i1"}
+    for p in parts:
+        lm = dict(p.part_key.labels)
+        # re-tagged into the reserved dataset + rule labels applied;
+        # NO worker label (a recorded series' identity must survive
+        # evaluator failover)
+        assert lm["_ws_"] == RULES_DATASET and lm["_ns_"] == "n0"
+        assert lm["tier"] == "gold" and "worker" not in lm
+        assert p.schema.name == "gauge"     # name heuristic: not *_total
+    (cp,) = _lookup(shard, "job:x:events_total")
+    assert cp.schema.name == "prom-counter"  # explicit schema: counter
+
+
+def test_eval_window_is_step_aligned_tail():
+    g = RuleGroup("g", 10.0, (
+        Rule("r:x", "sum(x)", "recording"),))
+    eng, ev, _ = _mk_engine([g], span_steps=8)
+    eng.eval_group_once(g, T0 + 105)        # unaligned on purpose
+    (_ds, _q, start_ms, step_ms, end_ms) = ev.calls[-1]
+    assert step_ms == 10_000
+    assert end_ms % step_ms == 0            # boundary-aligned grid
+    assert (end_ms - start_ms) // step_ms == 7   # span_steps-1 tail
+    # the next tick shares the grid phase: the results-cache key is
+    # identical modulo the slide (cache-warm tail recompute)
+    eng.eval_group_once(g, T0 + 115)
+    (_ds, _q, start2, step2, end2) = ev.calls[-1]
+    assert step2 == step_ms and end2 - end_ms == 10_000
+    assert start2 % step_ms == start_ms % step_ms
+
+
+def test_alert_state_machine_pending_firing_inactive():
+    g = RuleGroup("g", 10.0, (
+        Rule("Hot", "rate(x_total[1m]) > 1", "alerting", for_s=20.0,
+             labels=(("severity", "page"),),
+             annotations=(("summary", "hot {{ $value }}"),)),))
+    eng, ev, shard = _mk_engine([g])
+    q = "rate(x_total[1m]) > 1"
+
+    ev.series[q] = []                       # expr empty -> inactive
+    eng.eval_group_once(g, T0)
+    assert eng.alerts_payload()["alerts"] == []
+
+    ev.series[q] = [({"instance": "i0"}, 3.0)]
+    eng.eval_group_once(g, T0 + 10)         # active -> pending
+    (a,) = eng.alerts_payload()["alerts"]
+    assert a["state"] == STATE_PENDING and a["activeAt"] == T0 + 10
+    assert a["labels"]["severity"] == "page"
+    assert a["annotations"]["summary"] == "hot 3"
+
+    eng.eval_group_once(g, T0 + 20)         # held 10s < for 20s
+    (a,) = eng.alerts_payload()["alerts"]
+    assert a["state"] == STATE_PENDING
+
+    eng.eval_group_once(g, T0 + 30)         # held 20s -> firing
+    (a,) = eng.alerts_payload()["alerts"]
+    assert a["state"] == STATE_FIRING and a["value"] == 3.0
+
+    # synthetic state series rode the write-back rail
+    alerts_parts = _lookup(shard, "ALERTS")
+    states = {dict(p.part_key.labels)["alertstate"]
+              for p in alerts_parts}
+    assert states == {"pending", "firing"}
+    (fs,) = _lookup(shard, "ALERTS_FOR_STATE")
+    assert dict(fs.part_key.labels)["alertname"] == "Hot"
+
+    ev.series[q] = []                       # expr clears -> inactive
+    eng.eval_group_once(g, T0 + 40)
+    assert eng.alerts_payload()["alerts"] == []
+    tr = [(t["from"], t["to"])
+          for t in eng.alerts_payload()["transitions"]]
+    assert tr == [("inactive", "pending"), ("pending", "firing"),
+                  ("firing", "inactive")]
+
+
+def test_alert_for_zero_fires_immediately():
+    g = RuleGroup("g", 10.0, (
+        Rule("Now", "x > 1", "alerting", for_s=0.0),))
+    eng, ev, _ = _mk_engine([g])
+    ev.series["x > 1"] = [({}, 9.0)]
+    eng.eval_group_once(g, T0)
+    (a,) = eng.alerts_payload()["alerts"]
+    assert a["state"] == STATE_FIRING
+
+
+def test_eval_failure_keeps_alert_state_and_counts():
+    """An evaluation ERROR must not flap a firing alert to inactive —
+    the state is kept, the failure family counts, health goes err."""
+    g = RuleGroup("g", 10.0, (
+        Rule("Hot", "x > 1", "alerting", for_s=0.0),))
+    eng, ev, _ = _mk_engine([g])
+    ev.series["x > 1"] = [({}, 2.0)]
+    eng.eval_group_once(g, T0)
+    assert eng.alerts_payload()["alerts"][0]["state"] == STATE_FIRING
+
+    ev.raise_for.add("x > 1")
+    eng.eval_group_once(g, T0 + 10)
+    (a,) = eng.alerts_payload()["alerts"]
+    assert a["state"] == STATE_FIRING       # did not flap
+    payload = eng.rules_payload()
+    (rule,) = payload["groups"][0]["rules"]
+    assert rule["health"] == "err"
+    assert "injected eval failure" in rule["lastError"]
+    fails = {tuple(sorted(lbl.items())): v for lbl, v in
+             eng._m_failures.series()}
+    assert fails[(("group", "g"), ("rule", "Hot"))] == 1
+
+
+def test_rules_payload_explain_retains_last_eval():
+    g = RuleGroup("g", 10.0, (Rule("r:x", "sum(x)", "recording"),))
+    eng, ev, _ = _mk_engine([g])
+    ev.series["sum(x)"] = [({}, 1.0)]
+    eng.eval_group_once(g, T0 + 10)
+    plain = eng.rules_payload()["groups"][0]["rules"][0]
+    assert "lastEval" not in plain
+    assert plain["health"] == "ok" and plain["lastEvaluation"] == T0 + 10
+    rich = eng.rules_payload(explain=True)["groups"][0]["rules"][0]
+    le = rich["lastEval"]
+    assert le["query"] == "sum(x)" and le["samples"] == 1
+    assert le["stages"]["resultCache"] == "partial"
+    assert le["stages"]["rulePlanCache"] in ("miss", "uncacheable")
+
+
+def test_scheduler_due_skips_first_boundary_and_counts_missed():
+    g = RuleGroup("g", 10.0, (Rule("r:x", "sum(x)", "recording"),))
+    eng, ev, _ = _mk_engine([g])
+    ev.series["sum(x)"] = [({}, 1.0)]
+    # first due check only claims the current boundary (the previous
+    # evaluator is assumed to have run it)
+    assert eng.evaluate_due(now_s=T0 + 105) == 0
+    assert eng.evaluate_due(now_s=T0 + 107) == 0
+    assert eng.evaluate_due(now_s=T0 + 112) == 1    # next boundary
+    # a long stall skips boundaries -> missed counter
+    assert eng.evaluate_due(now_s=T0 + 145) == 1
+    missed = {tuple(sorted(lbl.items())): v for lbl, v in
+              eng._m_missed.series()}
+    assert missed[(("group", "g"),)] == 2
+
+
+def test_single_owner_election_and_takeover_skip():
+    clock = {"t": T0 + 100}
+    g = RuleGroup("g", 10.0, (Rule("r:x", "sum(x)", "recording"),))
+    eng, ev, _ = _mk_engine([g], worker_id=1, num_workers=3,
+                            clock=lambda: clock["t"])
+    ev.series["sum(x)"] = [({}, 1.0)]
+    # ordinal 1 of {0,1,2}: worker 0 evaluates, this engine stands by
+    assert not eng.snapshot()["active"]
+    assert eng.evaluate_due(now_s=T0 + 100) == 0
+    # worker 0 dies at T0+105 -> this engine takes over, CLAIMING the
+    # in-progress boundary at the election instant (the dead worker is
+    # assumed to have run it); the next boundary evaluates
+    clock["t"] = T0 + 105
+    eng.note_worker_exit(0)
+    assert eng.snapshot()["active"]
+    assert eng.evaluator_ordinal() == 1
+    assert eng.evaluate_due(now_s=T0 + 107) == 0     # claimed T0+100
+    assert eng.evaluate_due(now_s=T0 + 112) == 1     # owns T0+110
+    # worker 0 respawns at T0+121 -> step down, but a boundary that
+    # fell due BEFORE the handover beat and had not run yet (T0+120:
+    # scheduler-poll race) is still ours — ONE final catch-up pass
+    clock["t"] = T0 + 121
+    eng.note_worker_up(0)
+    assert not eng.snapshot()["active"]
+    assert eng.evaluate_due(now_s=T0 + 121.5) == 1   # catch-up: T0+120
+    assert eng.evaluate_due(now_s=T0 + 135) == 0     # retired
+    assert len(ev.calls) == 2
+
+
+def test_plan_cache_rebases_and_invalidates():
+    g = RuleGroup("g", 10.0, (
+        Rule("r:x", "rate(x_total[1m])", "recording"),))
+    eng, ev, _ = _mk_engine([g])
+    ev.series["rate(x_total[1m])"] = [({}, 1.0)]
+    eng.eval_group_once(g, T0 + 10)
+    eng.eval_group_once(g, T0 + 20)
+    st = eng.rules_payload(explain=True)["groups"][0]["rules"][0]
+    assert st["lastEval"]["stages"]["rulePlanCache"] == "hit"
+    eng.invalidate_plans("topology")
+    assert eng.snapshot()["plan_invalidations"] == 1
+    eng.eval_group_once(g, T0 + 30)
+    st = eng.rules_payload(explain=True)["groups"][0]["rules"][0]
+    assert st["lastEval"]["stages"]["rulePlanCache"] == "miss"
+
+
+def test_group_limit_is_enforced():
+    g = RuleGroup("g", 10.0, (Rule("r:x", "sum(x)", "recording"),),
+                  limit=1)
+    eng, ev, _ = _mk_engine([g])
+    ev.series["sum(x)"] = [({"i": "0"}, 1.0), ({"i": "1"}, 2.0)]
+    eng.eval_group_once(g, T0)
+    (rule,) = eng.rules_payload()["groups"][0]["rules"]
+    assert rule["health"] == "err" and "over the group limit" in \
+        rule["lastError"]
+
+
+# ---------------------------------------------------------------------------
+# write-back rail factoring (obs/writeback.py)
+# ---------------------------------------------------------------------------
+
+def test_schema_for_sample_heuristic():
+    assert schema_for_sample("counter", "x") == "prom-counter"
+    assert schema_for_sample("histogram", "x_bucket") == "prom-counter"
+    assert schema_for_sample("gauge", "x_total") == "prom-counter"
+    assert schema_for_sample("gauge", "x") == "gauge"
+
+
+def test_ingest_writeback_direct_and_flush():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    shard = store.setup(DatasetRef("wbtest"), 0, num_groups=2)
+    wb = IngestWriteBack(shard)
+    n = wb.write([
+        ("gauge", {"_metric_": "g1", "i": "0"}, T0 * 1000, 1.5),
+        ("prom-counter", {"_metric_": "c_total", "i": "0"},
+         T0 * 1000, 7.0),
+    ])
+    assert n == 2 and wb.samples_written == 2 and not wb.durable
+    wb.flush()
+    parts = shard.lookup_partitions([], 0, 1 << 62)
+    names = sorted(dict(p.part_key.labels)["_metric_"] for p in parts)
+    assert names == ["c_total", "g1"]
+
+
+def test_selfmon_uses_shared_rail():
+    """The factoring satellite's pin: SelfMonitor writes through the
+    same IngestWriteBack class the rules engine uses."""
+    from filodb_tpu.obs.selfmon import SelfMonitor
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    shard = store.setup(DatasetRef("__selfmon__"), 0, num_groups=2)
+
+    def src():
+        from filodb_tpu.obs.metrics import ExpositionBuilder
+        b = ExpositionBuilder()
+        b.sample("x_total", {}, 3, mtype="counter", help="x")
+        return b
+    sm = SelfMonitor(src, shard, interval_s=3600)
+    assert isinstance(sm.writeback, IngestWriteBack)
+    sm.collect_once(now_ms=T0 * 1000)
+    assert sm.writeback.samples_written == 1
+
+
+# ---------------------------------------------------------------------------
+# notifier
+# ---------------------------------------------------------------------------
+
+def test_notifier_queue_full_drops_not_blocks():
+    n = WebhookNotifier("http://127.0.0.1:1/none", queue_size=2)
+    assert n.enqueue({"status": "firing"})
+    assert n.enqueue({"status": "firing"})
+    assert not n.enqueue({"status": "firing"})
+    assert n.snapshot()["dropped"] == 1
+
+
+def test_notifier_delivers_with_retry_through_breaker():
+    """A flaky receiver (fails the first 2 attempts) still gets the
+    alert: retried under the resilience policy; the breaker tracks the
+    receiver."""
+    import http.server
+    import socketserver
+
+    fails = {"n": 2}
+    got = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length") or 0))
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            got.append(body)
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    with socketserver.TCPServer(("127.0.0.1", 0), H) as httpd:
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        from filodb_tpu.parallel.resilience import RetryPolicy
+        n = WebhookNotifier(f"http://127.0.0.1:{port}/hook",
+                            retry=RetryPolicy(max_attempts=4,
+                                              base_delay_s=0.01))
+        n.start()
+        assert n.enqueue({"status": "firing",
+                          "labels": {"alertname": "Hot"},
+                          "annotations": {"summary": "s"}})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not got:
+            time.sleep(0.05)
+        n.stop()
+        httpd.shutdown()
+    assert got, "webhook never delivered"
+    import json
+    payload = json.loads(got[0])
+    assert payload["alerts"][0]["labels"]["alertname"] == "Hot"
+    assert payload["status"] == "firing"
+    assert n.snapshot()["delivered"] == 1
+    # the registry recorded the retries on the breaker key
+    snap = n.breakers.metrics_snapshot()
+    (entry,) = snap.values()
+    assert entry["retries"] >= 2 and entry["state"] == "closed"
+
+
+def test_engine_enqueues_fire_and_resolve_notifications():
+    class _Spy:
+        def __init__(self):
+            self.items = []
+
+        def enqueue(self, n):
+            self.items.append(n)
+            return True
+
+        def stop(self, timeout=None):
+            pass
+
+    g = RuleGroup("g", 10.0, (
+        Rule("Hot", "x > 1", "alerting", for_s=0.0,
+             annotations=(("summary", "v={{ $value }}"),)),))
+    spy = _Spy()
+    eng, ev, _ = _mk_engine([g], notifier=spy)
+    ev.series["x > 1"] = [({}, 2.0)]
+    eng.eval_group_once(g, T0)
+    ev.series["x > 1"] = []
+    eng.eval_group_once(g, T0 + 10)
+    assert [n["status"] for n in spy.items] == ["firing", "resolved"]
+    assert spy.items[0]["annotations"]["summary"] == "v=2"
+    assert spy.items[0]["labels"]["alertname"] == "Hot"

@@ -1,0 +1,213 @@
+"""Single-owner rule scheduling under the worker supervisor: the rules
+config propagates to every worker, exactly ONE worker evaluates
+(lowest alive announced ordinal), and killing the evaluator mid-run
+re-elects via the bus worker-exit event with no missed and no
+duplicated tick — then the respawned ordinal 0 reclaims evaluation in
+one worker-up beat.
+"""
+
+import json
+import os
+import select
+import signal
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.rules import RULES_DATASET
+from filodb_tpu.standalone.supervisor import worker_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the recorded value is the evaluation timestamp itself: a REAL tick's
+# sample satisfies value == timestamp, while PromQL's lookback
+# forward-fill (which repeats the last sample across later grid steps)
+# does not — the exactly-once audit below keys on that
+RULES_CFG = {"groups": [{
+    "name": "fo", "interval": "1s", "rules": [
+        {"record": "fo:tick:value", "expr": "time()"}]}]}
+
+
+def test_worker_config_propagates_rules():
+    """Satellite pin: the supervisor-derived worker configs carry the
+    rules config verbatim (every worker loads it; election decides who
+    evaluates)."""
+    base = {"num-shards": 4, "rules": RULES_CFG,
+            "rules-eval-span-steps": 4,
+            "rules-webhook-url": "http://127.0.0.1:1/hook",
+            "max-inflight-queries": 8}
+    for ordinal in (0, 1):
+        cfg = worker_config(base, ordinal, 2, [1001, 1002], 9000, 9100)
+        assert cfg["rules"] == RULES_CFG
+        assert cfg["rules-eval-span-steps"] == 4
+        assert cfg["rules-webhook-url"] == "http://127.0.0.1:1/hook"
+        assert cfg["worker-id"] == ordinal
+        assert cfg["num-nodes"] == 2
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _poll(fn, timeout=180.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            ok, last = fn()
+        except (OSError, ValueError) as e:
+            ok, last = False, repr(e)
+        if ok:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}: {last!r}")
+
+
+def _recorded_ts(port):
+    """The ACTUAL recorded tick boundaries on one worker's private
+    port (its own __rules__ shard): grid points where the recorded
+    value equals the grid timestamp are real samples; lookback-filled
+    points repeat an older value and are filtered out."""
+    now = int(time.time())
+    out = _get(port, f"/promql/{RULES_DATASET}/api/v1/query_range",
+               query="fo:tick:value", start=now - 300, end=now + 2,
+               step=1)
+    return [int(float(t))
+            for r in out["data"]["result"] for t, v in r["values"]
+            if int(float(t)) == int(round(float(v)))]
+
+
+def test_kill_evaluator_no_missed_or_duplicated_tick(tmp_path):
+    cfg = {
+        "num-shards": 4, "port": 0,
+        "serving-workers": 2,
+        "supervisor-port": 0,
+        "run-dir": str(tmp_path / "run"),
+        "monitor-interval-s": 0.1,
+        # hold the respawn back so the stand-in's takeover window
+        # spans several 1s boundaries (a warm dev rig restarts a
+        # worker in under a second otherwise; backoff counts from the
+        # last SPAWN, so a cold boot eats into it — but a cold reboot
+        # is itself slow enough to leave a window)
+        "restart-backoff-s": 12.0,
+        "grpc-port": None,
+        "failure-detect-interval-s": 300.0,
+        "max-inflight-queries": 8,
+        "rules": RULES_CFG,
+    }
+    cfg_path = tmp_path / "sup.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.supervisor",
+         "--config", str(cfg_path)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    try:
+        buf = b""
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and b"\n" not in buf:
+            r, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if r:
+                ch = proc.stdout.read1(4096)
+                if not ch:
+                    raise RuntimeError("supervisor died during startup")
+                buf += ch
+        line = json.loads(buf.split(b"\n", 1)[0])
+        sup_port = line["supervisor_port"]
+        ports = {w["ordinal"]: w["port"] for w in line["workers"]}
+
+        # worker 0 is the announced evaluator; worker 1 stands by
+        def _w0_evaluating():
+            out = _get(ports[0], "/api/v1/rules", __local__=1)
+            return out["data"].get("evaluating") is True, out["data"]
+        _poll(_w0_evaluating, msg="worker 0 elected")
+        out1 = _get(ports[1], "/api/v1/rules", __local__=1)
+        assert out1["data"]["evaluating"] is False
+
+        # the stand-by worker PROXIES /api/v1/rules to the evaluator,
+        # so the public surface answers authoritatively from any worker
+        proxied = _get(ports[1], "/api/v1/rules")
+        assert proxied["data"]["evaluating"] is True
+        assert proxied["data"]["worker"] == 0
+
+        # wait for a few ticks, then kill RIGHT AFTER a fresh tick
+        # lands (maximum distance to the next boundary)
+        def _ticks(n):
+            def check():
+                ts = _recorded_ts(ports[0])
+                return len(ts) >= n, len(ts)
+            return check
+        _poll(_ticks(3), msg="pre-kill ticks")
+        n0 = len(_recorded_ts(ports[0]))
+        _poll(_ticks(n0 + 1), msg="fresh tick before kill")
+
+        health = _get(sup_port, "/__health")
+        victim_pid = health["workers"]["0"]["pid"]
+        restarts0 = health["workers"]["0"]["restarts"]
+        os.kill(victim_pid, signal.SIGKILL)
+        t_kill = time.time()
+
+        # worker 1 takes over via the bus worker-exit event and keeps
+        # the recorded series advancing
+        def _w1_took_over():
+            out = _get(ports[1], "/api/v1/rules", __local__=1)
+            ts = _recorded_ts(ports[1])
+            return (out["data"].get("evaluating") is True
+                    and any(t >= t_kill for t in ts)), \
+                (out["data"].get("evaluating"), len(ts))
+        _poll(_w1_took_over, timeout=60, msg="worker 1 takeover")
+
+        # the supervisor respawns worker 0; its worker-up broadcast
+        # makes worker 1 step down and worker 0 reclaim in one beat
+        def _respawned():
+            h = _get(sup_port, "/__health")["workers"]["0"]
+            return (h["restarts"] > restarts0 and h["alive"]
+                    and h["ready"]), h
+        _poll(_respawned, timeout=240, msg="worker 0 respawn")
+
+        def _reclaimed():
+            out0 = _get(ports[0], "/api/v1/rules", __local__=1)
+            out1 = _get(ports[1], "/api/v1/rules", __local__=1)
+            return (out0["data"].get("evaluating") is True
+                    and out1["data"].get("evaluating") is False), \
+                (out0["data"].get("evaluating"),
+                 out1["data"].get("evaluating"))
+        _poll(_reclaimed, timeout=60, msg="worker 0 reclaim")
+
+        # let the reclaimed evaluator run a few boundaries, then audit
+        time.sleep(3.5)
+
+        # -- the exactly-once audit ------------------------------------
+        # every recorded sample's timestamp is its interval boundary;
+        # union the two workers' shards: a duplicated tick would show
+        # the same boundary on BOTH workers, a missed tick a hole in
+        # the contiguous boundary walk
+        ts0 = _recorded_ts(ports[0])
+        ts1 = _recorded_ts(ports[1])
+        dup = set(ts0) & set(ts1)
+        assert not dup, f"duplicated ticks (both workers wrote): {dup}"
+        union = sorted(set(ts0) | set(ts1))
+        assert len(union) >= 8
+        holes = [t for t in range(union[0], union[-1] + 1)
+                 if t not in union]
+        assert not holes, (
+            f"missed ticks {holes} (worker0={sorted(ts0)}, "
+            f"worker1={sorted(ts1)})")
+        # both sides actually contributed (the failover really ran)
+        assert ts0 and ts1
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
