@@ -16,6 +16,7 @@ hot path — all bulk compute is device-side behind QueryEngine)."""
 
 from __future__ import annotations
 
+import functools
 import json
 import re
 import threading
@@ -56,6 +57,16 @@ INTERNAL_DATASETS = (SELFMON_DATASET, qos.RULES_TENANT)
 
 _QLAT_HELP = ("End-to-end query latency in seconds at the HTTP edge "
               "(parse + plan + execute + encode)")
+
+
+# promlint findings per (query text, schema snapshot): queries repeat
+# (dashboards), the analysis is pure, and the hot path must not re-walk
+# the AST per refresh
+@functools.lru_cache(maxsize=512)
+def _lint_memo(query: str, schema_items: Tuple) -> Tuple:
+    from filodb_tpu.promql import semant
+    schemas = semant.MetricSchemas(dict(schema_items))
+    return tuple(semant.lint_query(query, schemas))
 
 
 class _Handled(Exception):
@@ -1291,6 +1302,50 @@ class FiloHttpServer:
         except ValueError:
             return default_s
 
+    def _lint_schema_items(self) -> Tuple:
+        """Explicit metric-schema snapshot for promlint: the recording
+        rules' ``schema:`` declarations (PR 12 extension). Hashable so
+        the lint memo can key on it; recomputed per query — it is a
+        tiny tuple walk and rules can be reloaded at runtime."""
+        eng = self.rules
+        if eng is None:
+            return ()
+        items = []
+        for g in getattr(eng, "groups", ()):
+            for r in getattr(g, "rules", ()):
+                if getattr(r, "kind", "") == "recording" and \
+                        getattr(r, "schema", None):
+                    items.append((r.name, r.schema))
+        return tuple(sorted(items))
+
+    def _promql_lint(self, engine, qs, query: str):
+        """promlint on a user query: findings ride the response
+        ``warnings`` array; ``&lint=strict`` turns error-severity
+        findings into a 400 with structured diagnostics;
+        ``&lint=off`` skips. Returns None to proceed, or a (code,
+        payload) rejection."""
+        mode = (self._param(qs, "lint", "") or "").lower()
+        if mode == "off":
+            return None
+        diags = _lint_memo(query, self._lint_schema_items())
+        if not diags:
+            return None
+        if mode == "strict":
+            errs = [d for d in diags if d.severity == "error"]
+            if errs:
+                out = prom_json.error(
+                    "promlint: " + "; ".join(
+                        f"[{d.rule}] {d.message}" for d in errs),
+                    "bad_data")
+                out["lint"] = [
+                    {"rule": d.rule, "message": d.message,
+                     "pos": d.pos, "end": d.end,
+                     "severity": d.severity} for d in diags]
+                return 400, out
+        engine.stats.warnings.extend(
+            f"promlint: {d.render()}" for d in diags)
+        return None
+
     def _query_range(self, engine, qs, ds: str = "timeseries",
                      tctx=None):
         import time as _time
@@ -1369,6 +1424,18 @@ class FiloHttpServer:
             pc_state = "hit" if cached else \
                 ("miss" if self.plan_cache.enabled else "off")
             sp.tag(plan_cache=pc_state)
+        # promlint semantic diagnostics on the user query: warnings in
+        # the response envelope; &lint=strict -> 400 with diagnostics
+        lint_out = self._promql_lint(engine, qs, query)
+        if lint_out is not None:
+            return lint_out
+        if self._param(qs, "explain") == "analyze":
+            # QoS cross-check surface: the static cost lattice that
+            # must upper-bound estimate_cost's admission price
+            from filodb_tpu.promql import semant as _semant
+            stages["staticCostBound"] = _semant.static_cost_bound(
+                plan, getattr(engine, "shards", ()),
+                metering=getattr(engine, "metering", None)).to_json()
         # cost-based tenant admission (query/qos.py): price the parsed
         # plan BEFORE any execution and charge the tenant's token
         # bucket. Fan-out legs (dispatch=local) force-charge — the
@@ -1521,6 +1588,14 @@ class FiloHttpServer:
                 plan = parse_query(query, time_s)
                 self.plan_cache.store(ds, query, time_s * 1000, 0,
                                       time_s * 1000, plan)
+        lint_out = self._promql_lint(engine, qs, query)
+        if lint_out is not None:
+            return lint_out
+        if self._param(qs, "explain") == "analyze":
+            from filodb_tpu.promql import semant as _semant
+            stages["staticCostBound"] = _semant.static_cost_bound(
+                plan, getattr(engine, "shards", ()),
+                metering=getattr(engine, "metering", None)).to_json()
         # cost admission: instant queries charge too, but there is no
         # range to stale-serve/coarsen/trim — over budget means 429
         # (step=0 makes the ladder decline)

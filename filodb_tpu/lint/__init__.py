@@ -38,6 +38,14 @@ Rule families (see the rule modules for the catalog):
     donate-of-live-state (``donation-safety``, advisory
     ``donation-missing``), and PartitionSpec arity + axis-name
     consistency (``partition-spec-consistency``).
+  * ``rules_promql`` (promlint) — the PromQL surface
+    (``filodb_tpu/promql/semant.py``): every shipped rule file
+    (``examples/*.yaml``) loads through the rules loader with semantic
+    analysis (type/schema checking, label dataflow, normalized
+    duplicate detection), and a seeded differential micro-soak runs
+    generated well-typed queries engine-vs-reference
+    (``promql-differential-mismatch``); ``--changed-only`` skips the
+    soak (the full rail runs in tier-1).
   * ``rules_cache`` (v3) — the cache inventory (``caches.py``):
     every ``@publishes`` mutation publisher must reach every
     registered cache's invalidation hook (through inferred
@@ -272,8 +280,9 @@ def _load_rule_modules() -> None:
     _rule_modules_loaded = True
     from filodb_tpu.lint import (rules_cache,  # noqa: F401
                                  rules_concurrency, rules_hot,
-                                 rules_kernel, rules_lock, rules_span,
-                                 rules_spmd, rules_trace)
+                                 rules_kernel, rules_lock,
+                                 rules_promql, rules_span, rules_spmd,
+                                 rules_trace)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
@@ -296,7 +305,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     _load_rule_modules()
     from filodb_tpu.lint import (rules_cache, rules_concurrency,
                                  rules_hot, rules_kernel, rules_lock,
-                                 rules_span, rules_spmd, rules_trace)
+                                 rules_promql, rules_span, rules_spmd,
+                                 rules_trace)
     from filodb_tpu.lint import callgraph as _cgmod
     from filodb_tpu.lint import dataflow as _dfmod
     root = package_root()
@@ -339,6 +349,12 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     for relpath, f in rules_spmd.check_project(mods, cg=cg, df=df):
         raw.append((bymod_path.get(relpath), f))
     for relpath, f in rules_cache.check_project(mods, cg=cg, df=df):
+        raw.append((bymod_path.get(relpath), f))
+    # promql family: shipped rule-file sweep + (full runs only) the
+    # seeded differential micro-soak. --changed-only skips the soak —
+    # the fast pre-commit path; tier-1 runs the full rail.
+    for relpath, f in rules_promql.check_project(
+            mods, root, skip_soak=report_only is not None):
         raw.append((bymod_path.get(relpath), f))
     if check_contracts:
         bymod = {m.relpath: m for m in mods}

@@ -44,8 +44,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m filodb_tpu.lint",
         description="graftlint: kernel-contract, trace-safety, "
-                    "lock-discipline, SPMD/device-dataflow, and "
-                    "cache-invalidation static analysis")
+                    "lock-discipline, SPMD/device-dataflow, "
+                    "cache-invalidation, and PromQL-surface (promlint) "
+                    "static analysis")
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "filodb_tpu package)")

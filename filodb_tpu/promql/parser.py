@@ -31,7 +31,7 @@ METRIC_COLUMN = "_metric_"
 # ---------------------------------------------------------------------------
 
 _TOKEN_RE = re.compile(r"""
-    (?P<WS>\s+)
+    (?P<WS>\s+|\#[^\n]*)
   | (?P<DURATION>[0-9]+(?:\.[0-9]+)?(?:ms|s|m|h|d|w|y)(?:[0-9]+(?:\.[0-9]+)?(?:ms|s|m|h|d|w|y))*)
   | (?P<NUMBER>
         0x[0-9a-fA-F]+
@@ -49,9 +49,18 @@ _DUR_PART_RE = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ms|s|m|h|d|w|y)")
 
 
 def parse_duration_ms(text: str) -> int:
+    """Duration string -> milliseconds. Rejects empty/malformed text —
+    every part must parse, and the parts must cover the whole string
+    (``5mm``, ``5``, ``m5`` and "" all raise ValueError)."""
     total = 0.0
-    for num, unit in _DUR_PART_RE.findall(text):
-        total += float(num) * _DUR_UNIT_MS[unit]
+    covered = 0
+    for m in _DUR_PART_RE.finditer(text):
+        if m.start() != covered:
+            break
+        total += float(m.group(1)) * _DUR_UNIT_MS[m.group(2)]
+        covered = m.end()
+    if covered != len(text) or not text:
+        raise ValueError(f"invalid duration {text!r}")
     return int(total)
 
 
@@ -61,9 +70,21 @@ class Token:
     text: str
     pos: int
 
+    @property
+    def end(self) -> int:
+        return self.pos + len(self.text)
+
 
 class ParseError(ValueError):
-    pass
+    """Syntax/semantic rejection at parse time. ``pos``/``end`` are
+    character offsets into the query text (-1 = unknown) so callers can
+    render a caret span (promlint diagnostics reuse these spans)."""
+
+    def __init__(self, message: str, pos: int = -1, end: int = -1):
+        super().__init__(message)
+        self.pos = int(pos)
+        self.end = int(end) if end >= 0 else \
+            (int(pos) + 1 if pos >= 0 else -1)
 
 
 def tokenize(q: str) -> List[Token]:
@@ -72,7 +93,8 @@ def tokenize(q: str) -> List[Token]:
     while pos < len(q):
         m = _TOKEN_RE.match(q, pos)
         if not m:
-            raise ParseError(f"unexpected character {q[pos]!r} at {pos}")
+            raise ParseError(f"unexpected character {q[pos]!r} at {pos}",
+                             pos=pos)
         kind = m.lastgroup
         if kind != "WS":
             out.append(Token(kind, m.group(), pos))
@@ -101,22 +123,30 @@ class Selector:
     # query range at plan conversion
     at_ms: object = None
     column: Optional[str] = None   # FiloDB ::column suffix
+    pos: int = -1                  # char span in the query text
+    end: int = -1
 
 
 @dataclass
 class NumLit:
     value: float
+    pos: int = -1
+    end: int = -1
 
 
 @dataclass
 class StrLit:
     value: str
+    pos: int = -1
+    end: int = -1
 
 
 @dataclass
 class Call:
     name: str
     args: List
+    pos: int = -1
+    end: int = -1
 
 
 @dataclass
@@ -126,6 +156,8 @@ class Agg:
     params: List
     by: Tuple[str, ...] = ()
     without: Tuple[str, ...] = ()
+    pos: int = -1
+    end: int = -1
 
 
 @dataclass
@@ -139,6 +171,8 @@ class BinOp:
     group_left: bool = False
     group_right: bool = False
     include: Tuple[str, ...] = ()
+    pos: int = -1                  # span of the operator token
+    end: int = -1
 
 
 @dataclass
@@ -150,12 +184,21 @@ class Subquery:
     # int ms, or "start"/"end" (@ start()/@ end()), resolved against the
     # query range at plan conversion
     at_ms: object = None
+    pos: int = -1
+    end: int = -1
 
 
 @dataclass
 class Unary:
     op: str
     expr: object
+    pos: int = -1
+    end: int = -1
+
+
+def ast_span(node) -> Tuple[int, int]:
+    """(pos, end) char span of any AST node (-1, -1 when unknown)."""
+    return (getattr(node, "pos", -1), getattr(node, "end", -1))
 
 
 AGG_OPS = {"sum", "avg", "min", "max", "count", "stddev", "stdvar", "group",
@@ -215,7 +258,7 @@ class Parser:
     def next(self) -> Token:
         t = self.peek()
         if t is None:
-            raise ParseError("unexpected end of query")
+            raise ParseError("unexpected end of query", pos=self._eof_pos())
         self.i += 1
         return t
 
@@ -230,17 +273,27 @@ class Parser:
         t = self.peek()
         if t is None or t.text != text:
             got = t.text if t else "<eof>"
-            raise ParseError(f"expected {text!r}, got {got!r}")
+            raise ParseError(f"expected {text!r}, got {got!r}",
+                             pos=t.pos if t else self._eof_pos(),
+                             end=t.end if t else -1)
         return self.next()
 
     def at_end(self) -> bool:
         return self.i >= len(self.toks)
 
+    def _eof_pos(self) -> int:
+        return self.toks[-1].end if self.toks else 0
+
+    def _last_end(self) -> int:
+        return self.toks[self.i - 1].end if self.i else 0
+
     # -- grammar ---------------------------------------------------------
     def parse(self):
         e = self.parse_expr(0)
         if not self.at_end():
-            raise ParseError(f"trailing input at {self.peek().text!r}")
+            t = self.peek()
+            raise ParseError(f"trailing input at {t.text!r}",
+                             pos=t.pos, end=t.end)
         return e
 
     def parse_expr(self, level: int):
@@ -252,7 +305,8 @@ class Parser:
             t = self.peek()
             if t is None or t.text not in ops:
                 break
-            op = self.next().text
+            op_tok = self.next()
+            op = op_tok.text
             return_bool = False
             if self.peek() is not None and self.peek().text == "bool":
                 self.next()
@@ -281,7 +335,7 @@ class Parser:
             else:
                 rhs = self.parse_expr(level + 1)
             lhs = BinOp(op, lhs, rhs, return_bool, on, ignoring, gl, gr,
-                        include)
+                        include, pos=op_tok.pos, end=op_tok.end)
             lhs = self._postfix(lhs)
             if assoc == "right":
                 break
@@ -293,7 +347,8 @@ class Parser:
         while not self.accept(")"):
             t = self.next()
             if t.kind not in ("IDENT",):
-                raise ParseError(f"expected label name, got {t.text!r}")
+                raise ParseError(f"expected label name, got {t.text!r}",
+                                 pos=t.pos, end=t.end)
             labels.append(t.text)
             if not self.accept(","):
                 self.expect(")")
@@ -307,8 +362,10 @@ class Parser:
             inner = self.parse_unary()
             if t.text == "-":
                 if isinstance(inner, NumLit):
-                    return NumLit(-inner.value)
-                return Unary("-", inner)
+                    return NumLit(-inner.value, pos=t.pos,
+                                  end=getattr(inner, "end", -1))
+                return Unary("-", inner, pos=t.pos,
+                             end=getattr(inner, "end", -1))
             return inner
         return self.parse_postfix()
 
@@ -324,26 +381,38 @@ class Parser:
             if t.text == "[":
                 self.next()
                 d = self.next()
-                if d.kind not in ("DURATION", "NUMBER"):
-                    raise ParseError(f"expected duration, got {d.text!r}")
-                window = parse_duration_ms(d.text) if d.kind == "DURATION" \
-                    else int(float(d.text) * 1000)
+                window = self._duration_token(d, "duration")
+                if window <= 0:
+                    # a zero/empty window selects nothing a range
+                    # function could ever evaluate — reject at parse
+                    # time instead of returning all-NaN at eval time
+                    raise ParseError(
+                        f"zero-length range window {d.text!r}",
+                        pos=d.pos, end=d.end)
                 if self.accept(":"):
                     step = None
                     nt = self.peek()
                     if nt is not None and nt.text != "]":
                         sd = self.next()
-                        step = parse_duration_ms(sd.text) \
-                            if sd.kind == "DURATION" \
-                            else int(float(sd.text) * 1000)
+                        step = self._duration_token(sd, "subquery step")
+                        if step <= 0:
+                            # Prometheus rejects explicit zero subquery
+                            # resolution ([5m:0s]) — pinned behavior
+                            raise ParseError(
+                                f"zero subquery step {sd.text!r}",
+                                pos=sd.pos, end=sd.end)
                     self.expect("]")
-                    e = Subquery(e, window, step)
+                    e = Subquery(e, window, step,
+                                 pos=getattr(e, "pos", t.pos),
+                                 end=self._last_end())
                 else:
                     self.expect("]")
                     if not isinstance(e, Selector):
                         raise ParseError(
-                            "range selector applies only to vector selectors")
+                            "range selector applies only to vector selectors",
+                            pos=t.pos, end=self._last_end())
                     e.window_ms = window
+                    e.end = self._last_end()
             elif t.text == "offset":
                 self.next()
                 d = self.next()
@@ -351,15 +420,16 @@ class Parser:
                 if d.text == "-":
                     sign = -1
                     d = self.next()
-                off = parse_duration_ms(d.text) if d.kind == "DURATION" \
-                    else int(float(d.text) * 1000)
+                off = self._duration_token(d, "offset duration")
                 off *= sign
                 if isinstance(e, Selector):
                     e.offset_ms = off
                 elif isinstance(e, Subquery):
                     e.offset_ms = off
                 else:
-                    raise ParseError("offset applies to selectors")
+                    raise ParseError("offset applies to selectors",
+                                     pos=t.pos, end=d.end)
+                e.end = self._last_end()
             elif t.text == "@":
                 self.next()
                 at = self.next()
@@ -377,18 +447,33 @@ class Parser:
                     at_ms = sign * int(float(at.text) * 1000)
                 if isinstance(e, (Selector, Subquery)):
                     e.at_ms = at_ms
+                    e.end = self._last_end()
                 else:
                     raise ParseError(
                         "@ modifier is only supported on vector and range "
-                        "selectors and subqueries")
+                        "selectors and subqueries",
+                        pos=t.pos, end=self._last_end())
             else:
                 break
         return e
 
+    def _duration_token(self, d: Token, what: str) -> int:
+        """ms value of a DURATION/NUMBER token, with a spanned error on
+        anything else (the old path crashed on malformed text)."""
+        try:
+            if d.kind == "DURATION":
+                return parse_duration_ms(d.text)
+            if d.kind == "NUMBER":
+                return int(float(d.text) * 1000)
+        except ValueError:
+            pass
+        raise ParseError(f"expected {what}, got {d.text!r}",
+                         pos=d.pos, end=d.end)
+
     def parse_primary(self):
         t = self.peek()
         if t is None:
-            raise ParseError("unexpected end of query")
+            raise ParseError("unexpected end of query", pos=self._eof_pos())
         if t.text == "(":
             self.next()
             e = self.parse_expr(0)
@@ -398,21 +483,22 @@ class Parser:
             self.next()
             txt = t.text.lower()
             if txt.startswith("0x"):
-                return NumLit(float(int(txt, 16)))
+                return NumLit(float(int(txt, 16)), pos=t.pos, end=t.end)
             if txt == "inf":
-                return NumLit(float("inf"))
+                return NumLit(float("inf"), pos=t.pos, end=t.end)
             if txt == "nan":
-                return NumLit(float("nan"))
-            return NumLit(float(t.text))
+                return NumLit(float("nan"), pos=t.pos, end=t.end)
+            return NumLit(float(t.text), pos=t.pos, end=t.end)
         if t.kind == "STRING":
             self.next()
-            return StrLit(_unquote(t.text))
+            return StrLit(_unquote(t.text), pos=t.pos, end=t.end)
         if t.kind == "DURATION":
             # bare duration as number of seconds (PromQL durations-as-numbers)
             self.next()
-            return NumLit(parse_duration_ms(t.text) / 1000.0)
+            return NumLit(parse_duration_ms(t.text) / 1000.0,
+                          pos=t.pos, end=t.end)
         if t.text == "{":
-            return self._selector(None)
+            return self._selector(None, t.pos)
         if t.kind == "IDENT":
             # aggregation with leading grouping: sum by (x) (...)
             if t.text in AGG_OPS and t.text != "absent_hack":
@@ -421,10 +507,11 @@ class Parser:
             if nxt is not None and nxt.text == "(" and _is_function(t.text):
                 return self._call()
             self.next()
-            return self._selector(t.text)
-        raise ParseError(f"unexpected token {t.text!r}")
+            return self._selector(t.text, t.pos)
+        raise ParseError(f"unexpected token {t.text!r}", pos=t.pos,
+                         end=t.end)
 
-    def _selector(self, metric: Optional[str]) -> Selector:
+    def _selector(self, metric: Optional[str], pos: int = -1) -> Selector:
         column = None
         if metric and "::" in metric:
             metric, column = metric.split("::", 1)
@@ -434,24 +521,30 @@ class Parser:
             while not self.accept("}"):
                 lt = self.next()
                 if lt.kind not in ("IDENT",) and not lt.kind == "STRING":
-                    raise ParseError(f"expected label, got {lt.text!r}")
+                    raise ParseError(f"expected label, got {lt.text!r}",
+                                     pos=lt.pos, end=lt.end)
                 label = lt.text
                 opt = self.next()
                 if opt.text not in ("=", "!=", "=~", "!~"):
-                    raise ParseError(f"bad matcher op {opt.text!r}")
+                    raise ParseError(f"bad matcher op {opt.text!r}",
+                                     pos=opt.pos, end=opt.end)
                 vt = self.next()
                 if vt.kind != "STRING":
-                    raise ParseError("matcher value must be a string")
+                    raise ParseError("matcher value must be a string",
+                                     pos=vt.pos, end=vt.end)
                 matchers.append(Matcher(label, opt.text, _unquote(vt.text)))
                 if not self.accept(","):
                     self.expect("}")
                     break
         if metric is None and not matchers:
-            raise ParseError("empty selector")
-        return Selector(metric, matchers, column=column)
+            raise ParseError("empty selector", pos=pos,
+                             end=self._last_end())
+        return Selector(metric, matchers, column=column, pos=pos,
+                        end=self._last_end())
 
     def _aggregation(self) -> Agg:
-        op = self.next().text
+        op_tok = self.next()
+        op = op_tok.text
         by: Tuple[str, ...] = ()
         without: Tuple[str, ...] = ()
         t = self.peek()
@@ -480,11 +573,14 @@ class Parser:
         params = args[:-1]
         expr = args[-1]
         if op in AGG_PARAM_OPS and len(args) < 2:
-            raise ParseError(f"{op} requires a parameter")
-        return Agg(op, expr, params, by, without)
+            raise ParseError(f"{op} requires a parameter",
+                             pos=op_tok.pos, end=op_tok.end)
+        return Agg(op, expr, params, by, without, pos=op_tok.pos,
+                   end=self._last_end())
 
     def _call(self) -> Call:
-        name = self.next().text
+        name_tok = self.next()
+        name = name_tok.text
         self.expect("(")
         args: List = []
         if not self.accept(")"):
@@ -493,7 +589,7 @@ class Parser:
                 if not self.accept(","):
                     break
             self.expect(")")
-        return Call(name, args)
+        return Call(name, args, pos=name_tok.pos, end=self._last_end())
 
 
 def _is_function(name: str) -> bool:
@@ -755,6 +851,101 @@ def parse_query(query: str, time_s: int,
     """Instant query at one timestamp (step=0 -> single step)."""
     return parse_query_range(query, TimeStepParams(time_s, 1, time_s),
                              lookback_ms)
+
+
+def _fmt_num(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "Inf" if v > 0 else "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_dur(ms: int) -> str:
+    return f"{int(ms)}ms"
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def ast_to_text(ast) -> str:
+    """Canonical (normalized) rendering of a parsed AST: one spacing,
+    sorted matchers/grouping labels, ms-unit durations, explicit parens.
+    Two queries with the same rendering are SYNTACTICALLY equivalent
+    modulo whitespace/comments/label order — the rules loader's
+    duplicate detection compares these instead of raw text."""
+    if isinstance(ast, NumLit):
+        return _fmt_num(ast.value)
+    if isinstance(ast, StrLit):
+        return _quote(ast.value)
+    if isinstance(ast, Unary):
+        return f"(-{ast_to_text(ast.expr)})"
+    if isinstance(ast, Selector):
+        parts = []
+        for m in sorted(ast.matchers, key=lambda m: (m.label, m.op,
+                                                     m.value)):
+            parts.append(f"{m.label}{m.op}{_quote(m.value)}")
+        name = ast.metric or ""
+        if ast.column:
+            name += f"::{ast.column}"
+        out = name + ("{" + ",".join(parts) + "}" if parts else
+                      ("{}" if not name else ""))
+        if ast.window_ms is not None:
+            out += f"[{_fmt_dur(ast.window_ms)}]"
+        return out + _mods(ast)
+    if isinstance(ast, Subquery):
+        step = _fmt_dur(ast.step_ms) if ast.step_ms else ""
+        return (f"{ast_to_text(ast.expr)}[{_fmt_dur(ast.window_ms)}:"
+                f"{step}]" + _mods(ast))
+    if isinstance(ast, Call):
+        return (f"{ast.name}(" +
+                ",".join(ast_to_text(a) for a in ast.args) + ")")
+    if isinstance(ast, Agg):
+        grp = ""
+        if ast.by:
+            grp = " by (" + ",".join(sorted(ast.by)) + ") "
+        elif ast.without:
+            grp = " without (" + ",".join(sorted(ast.without)) + ") "
+        args = list(ast.params) + [ast.expr]
+        return (f"{ast.op}{grp}(" +
+                ",".join(ast_to_text(a) for a in args) + ")")
+    if isinstance(ast, BinOp):
+        mods = []
+        if ast.return_bool:
+            mods.append("bool")
+        if ast.on is not None:
+            mods.append("on(" + ",".join(sorted(ast.on)) + ")")
+        elif ast.ignoring:
+            mods.append("ignoring(" + ",".join(sorted(ast.ignoring)) + ")")
+        if ast.group_left or ast.group_right:
+            g = "group_left" if ast.group_left else "group_right"
+            if ast.include:
+                g += "(" + ",".join(sorted(ast.include)) + ")"
+            mods.append(g)
+        mid = " ".join([ast.op] + mods)
+        return f"({ast_to_text(ast.lhs)} {mid} {ast_to_text(ast.rhs)})"
+    raise ValueError(f"cannot render {type(ast).__name__}")
+
+
+def _mods(ast) -> str:
+    out = ""
+    if getattr(ast, "offset_ms", 0):
+        out += f" offset {_fmt_dur(ast.offset_ms)}"
+    at = getattr(ast, "at_ms", None)
+    if at is not None:
+        out += f" @ {at}()" if at in ("start", "end") else \
+            f" @ {at / 1000.0:g}"
+    return out
+
+
+def normalize_query(query: str) -> str:
+    """Whitespace/comment/label-order-insensitive normal form of a
+    query (parses, then renders canonically). Raises ParseError on
+    invalid input."""
+    return ast_to_text(Parser(query).parse())
 
 
 def selector_to_filters(selector: str) -> Tuple[ColumnFilter, ...]:

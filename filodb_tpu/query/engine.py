@@ -550,10 +550,22 @@ def _apply_op(op: str, a, b, return_bool: bool):
 
 def scalar_vector_op(grid: GridResult, scalar, op: str, scalar_is_lhs: bool,
                      return_bool: bool = False) -> GridResult:
-    """(exec/RangeVectorTransformer.scala:201 ScalarOperationMapper)."""
+    """(exec/RangeVectorTransformer.scala:201 ScalarOperationMapper).
+
+    A FILTERING comparison (no ``bool``) always retains the VECTOR
+    side's sample values regardless of operand order — ``10 < foo``
+    keeps foo's values, not a broadcast 10. The generic ``_apply_op``
+    filter keeps its left operand, which is only correct when the
+    vector IS the left operand; pinned by the promql differential
+    rail (test_pinned_scalar_lhs_comparison_filter)."""
     sv = scalar.values if isinstance(scalar, ScalarResult) else scalar
     a, b = (sv, grid.values) if scalar_is_lhs else (grid.values, sv)
-    out = _apply_op(op, a, b, return_bool)
+    if op in _COMP and not return_bool:
+        with np.errstate(all="ignore"):
+            m = _COMP[op](a, b)
+        out = np.where(m, grid.values, np.nan)
+    else:
+        out = _apply_op(op, a, b, return_bool)
     keys = [strip_metric(k) for k in grid.keys]
     return GridResult(grid.steps, keys, out)
 
@@ -1262,6 +1274,15 @@ def lp_replace_range(plan, start_ms: int, step_ms: int, end_ms: int):
             plan,
             scalar=lp_replace_range(plan.scalar, start_ms, step_ms, end_ms),
             vector=lp_replace_range(plan.vector, start_ms, step_ms, end_ms))
+    if isinstance(plan, lp.SubqueryWithWindowing):
+        # rebase the subquery's OUTER grid only; its inner expression is
+        # rebased by _subquery at eval time from these bounds. Without
+        # this case a NESTED subquery kept its parse-time grid and the
+        # enclosing subquery windowed over a truncated inner range —
+        # found by the promql differential rail (pinned:
+        # test_pinned_nested_subquery_rebase)
+        return dataclasses.replace(plan, start_ms=start_ms,
+                                   step_ms=step_ms, end_ms=end_ms)
     if isinstance(plan, (lp.ScalarTimeBasedPlan, lp.ScalarFixedDoublePlan)):
         return dataclasses.replace(plan, start_ms=start_ms, step_ms=step_ms,
                                    end_ms=end_ms)

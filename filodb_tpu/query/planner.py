@@ -652,6 +652,16 @@ class QueryPlanner:
         return qos.estimate_plan_cost(plan, self.shards,
                                       metering=self.metering)
 
+    def static_cost_bound(self, plan):
+        """Static ceiling on :meth:`estimate_cost` for the same plan
+        (promql/semant.py cost lattice): bound.total >= estimate_cost
+        (plan).total for every plan shape — the QoS cross-check pinned
+        by tests/test_promql_cost_bound.py, surfaced under
+        ``&explain=analyze``."""
+        from filodb_tpu.promql.semant import static_cost_bound
+        return static_cost_bound(plan, self.shards,
+                                 metering=self.metering)
+
     def _remote_kw(self) -> Dict:
         """Resilience kwargs shared by every remote shard group."""
         return dict(retry=self.resilience.retry,

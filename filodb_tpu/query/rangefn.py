@@ -422,6 +422,18 @@ RANGE_FUNCTIONS: Dict[str, Callable] = {
 # functions that interpret the value column as a monotonic counter
 COUNTER_FUNCTIONS = frozenset({"rate", "increase", "irate", "resets"})
 
+# functions whose semantics assume a gauge: applying them to a counter
+# silently ignores resets (promlint warns — semant.py schema family)
+GAUGE_FUNCTIONS = frozenset({"delta", "idelta", "deriv"})
+
+# scalar-parameter arity per range function beyond the range-vector arg
+# (promlint arity checking; the parser's plan builder indexes args
+# positionally and would IndexError without this pre-check)
+RANGE_FN_SCALAR_ARITY: Dict[str, int] = {
+    "quantile_over_time": 1, "z_score": 0, "mad_over_time": 0,
+    "predict_linear": 1, "holt_winters": 2,
+}
+
 
 def evaluate(func: str, ts: np.ndarray, vals: np.ndarray,
              start_ms: int, step_ms: int, end_ms: int, window_ms: int,

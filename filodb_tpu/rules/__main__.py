@@ -1,14 +1,17 @@
 """``python -m filodb_tpu.rules --check <file>``: promtool-style rule
 file validation — structural checks, PromQL syntax through the NORMAL
-parser (no second grammar to drift), duplicate-rule detection. Exit 0 =
-clean; exit 1 = findings (printed one per line); exit 2 = usage."""
+parser (no second grammar to drift), promlint semantic analysis
+(type/schema checking, label dataflow — spanned diagnostics), and
+normalized duplicate-rule detection. Exit 0 = clean (warnings may
+print); exit 1 = errors (printed one per line); exit 2 = usage."""
 
 from __future__ import annotations
 
 import argparse
 import sys
 
-from filodb_tpu.rules.loader import check_rules_file, load_rules_file
+from filodb_tpu.rules.loader import (check_rules_file_full,
+                                     load_rules_file)
 
 
 def main(argv=None) -> int:
@@ -19,7 +22,9 @@ def main(argv=None) -> int:
     if not args.check:
         p.print_usage(sys.stderr)
         return 2
-    errors = check_rules_file(args.check)
+    errors, warnings = check_rules_file_full(args.check)
+    for w in warnings:
+        print(f"{args.check}: warning: {w}")
     if errors:
         for e in errors:
             print(f"{args.check}: {e}")
@@ -27,7 +32,8 @@ def main(argv=None) -> int:
     groups = load_rules_file(args.check)
     n_rules = sum(len(g.rules) for g in groups)
     print(f"{args.check}: OK — {len(groups)} group(s), "
-          f"{n_rules} rule(s)")
+          f"{n_rules} rule(s)"
+          + (f", {len(warnings)} warning(s)" if warnings else ""))
     return 0
 
 

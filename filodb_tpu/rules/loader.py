@@ -15,11 +15,16 @@ YAML dependency, matching the repo's no-new-deps rule. Two extensions:
   schema, so ``rate()`` over a recorded counter gets reset correction).
 
 Validation is promtool-shaped: structural errors, PromQL syntax through
-the NORMAL parser (the engine evaluates exactly what validated), and
+the NORMAL parser (the engine evaluates exactly what validated),
 duplicate-rule detection (same type + name + static labels anywhere in
-the file). ``python -m filodb_tpu.rules --check <file>`` runs it from
-the command line; the shipped example file is validated in the tier-1
-gate.
+the file, plus parser-NORMALIZED expression comparison so whitespace/
+label-order variants are caught), and promlint semantic analysis
+(:mod:`filodb_tpu.promql.semant`): type/schema errors — e.g. ``rate()``
+on a metric another rule declares ``schema: gauge`` — and provably-
+broken vector matching REJECT the file at load time; warning-severity
+findings surface without failing. ``python -m filodb_tpu.rules --check
+<file>`` runs it from the command line; the shipped example file is
+validated in the tier-1 gate.
 """
 
 from __future__ import annotations
@@ -29,7 +34,9 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from filodb_tpu.promql import semant
 from filodb_tpu.promql.parser import (ParseError, TimeStepParams,
+                                      normalize_query,
                                       parse_duration_ms,
                                       parse_query_range)
 
@@ -113,12 +120,14 @@ def _check_expr(expr: str, where: str, errors: List[str]) -> None:
         errors.append(f"{where}: expression rejected: {e}")
 
 
-def load_groups(obj, errors: Optional[List[str]] = None
-                ) -> List[RuleGroup]:
+def load_groups(obj, errors: Optional[List[str]] = None,
+                warnings: Optional[List[str]] = None) -> List[RuleGroup]:
     """Parse the Python-object form (``{"groups": [...]}`` or a bare
     group list). With ``errors=None`` raises :class:`RuleLoadError` on
-    any finding; otherwise appends findings and returns what parsed."""
+    any finding; otherwise appends findings and returns what parsed.
+    ``warnings`` (optional) collects non-fatal promlint findings."""
     own_errors = errors if errors is not None else []
+    own_warnings = warnings if warnings is not None else []
     groups: List[RuleGroup] = []
     if isinstance(obj, dict):
         raw_groups = obj.get("groups")
@@ -133,6 +142,8 @@ def load_groups(obj, errors: Optional[List[str]] = None
         raw_groups = []
     seen_groups: set = set()
     seen_rules: Dict[Tuple, str] = {}
+    # (where, kind, name, expr) for the promlint/normalization post-pass
+    pending: List[Tuple[str, str, str, str]] = []
     for gi, g in enumerate(raw_groups):
         gw = f"group[{gi}]"
         if not isinstance(g, dict):
@@ -175,6 +186,7 @@ def load_groups(obj, errors: Optional[List[str]] = None
                 own_errors.append(f"{rw}: missing expr")
                 continue
             _check_expr(expr, rw, own_errors)
+            pending.append((rw, kind, rname, str(expr)))
             labels = _str_map(r.get("labels"), rw, own_errors,
                               check_names=True)
             annotations = _str_map(r.get("annotations"), rw, own_errors)
@@ -219,12 +231,52 @@ def load_groups(obj, errors: Optional[List[str]] = None
             name=name, interval_s=interval_s, rules=tuple(rules),
             dataset=str(ds) if ds else None,
             limit=int(g.get("limit") or 0)))
+    _semantic_pass(groups, pending, own_errors, own_warnings)
     if errors is None and own_errors:
         raise RuleLoadError(own_errors)
     return groups
 
 
-def parse_rules_text(text: str, errors: Optional[List[str]] = None
+def _semantic_pass(groups: List[RuleGroup],
+                   pending: List[Tuple[str, str, str, str]],
+                   errors: List[str], warnings: List[str]) -> None:
+    """Post-parse pass over every rule expression: promlint semantic
+    diagnostics (error severity rejects the file; warnings surface),
+    and parser-NORMALIZED duplicate detection — whitespace/label-order
+    expression variants compare equal, and two recording rules that
+    evaluate the identical normalized expression warn (the second is a
+    wasted standing evaluation)."""
+    # schema resolution sees EVERY recording rule's schema: extension,
+    # across groups, so forward references resolve
+    schemas = semant.MetricSchemas.from_rule_groups(groups)
+    norm_seen: Dict[str, Tuple[str, str]] = {}
+    for where, kind, rname, expr in pending:
+        for d in semant.lint_query(expr, schemas):
+            msg = f"{where}: promlint: {d.render(expr)}"
+            if d.severity == semant.ERROR and \
+                    d.rule != "promql-syntax":
+                # syntax errors were already reported by _check_expr
+                errors.append(msg)
+            elif d.severity == semant.WARNING:
+                warnings.append(msg)
+        if kind != "recording":
+            continue
+        try:
+            norm = normalize_query(expr)
+        except (ParseError, ValueError):
+            continue
+        prev = norm_seen.get(norm)
+        if prev is not None and prev[1] != rname:
+            warnings.append(
+                f"{where}: semantically identical expression to "
+                f"{prev[0]} (normalized: {norm}) — one standing "
+                f"evaluation would serve both")
+        elif prev is None:
+            norm_seen[norm] = (where, rname)
+
+
+def parse_rules_text(text: str, errors: Optional[List[str]] = None,
+                     warnings: Optional[List[str]] = None
                      ) -> List[RuleGroup]:
     """Parse YAML (when PyYAML is importable) or JSON rule-file text."""
     own_errors = errors if errors is not None else []
@@ -251,7 +303,7 @@ def parse_rules_text(text: str, errors: Optional[List[str]] = None
         if errors is None and own_errors:
             raise RuleLoadError(own_errors)
         return []
-    out = load_groups(obj, errors=own_errors)
+    out = load_groups(obj, errors=own_errors, warnings=warnings)
     if errors is None and own_errors:
         raise RuleLoadError(own_errors)
     return out
@@ -266,13 +318,21 @@ def check_rules_file(path: str) -> List[str]:
     """promtool-style validation: returns human-readable findings
     (empty = clean). Never raises on content errors — unreadable files
     come back as a finding too."""
+    return check_rules_file_full(path)[0]
+
+
+def check_rules_file_full(path: str) -> Tuple[List[str], List[str]]:
+    """(errors, warnings) — errors reject the file (exit 1 from
+    ``--check``); warning-severity promlint findings surface without
+    failing (promtool's check-rules warning behavior)."""
     errors: List[str] = []
+    warnings: List[str] = []
     try:
         with open(path) as f:
             text = f.read()
     except OSError as e:
-        return [f"cannot read {path}: {e}"]
-    groups = parse_rules_text(text, errors=errors)
+        return [f"cannot read {path}: {e}"], warnings
+    groups = parse_rules_text(text, errors=errors, warnings=warnings)
     if not errors and not groups:
         errors.append("no rule groups found")
-    return errors
+    return errors, warnings

@@ -1,0 +1,236 @@
+"""promlint wiring tests: rules --check semantic rejection, normalized
+duplicate detection, the graftlint promql family (--json/--github
+emitters, --changed-only soak skip, 30s perf guard), and the HTTP
+edge's lint warnings / &lint=strict behavior."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.rules import __main__ as rules_main
+from filodb_tpu.rules.loader import (RuleLoadError, check_rules_file_full,
+                                     load_groups, parse_rules_text)
+
+BAD_RULES = """
+groups:
+  - name: bad
+    interval: 30s
+    rules:
+      - record: app:mem:avg
+        expr: avg(mem_usage)
+        schema: gauge
+      - record: app:mem:rate
+        expr: rate(app:mem:avg[5m])
+      - record: app:join
+        expr: sum by (job) (cpu_usage) * on (instance) sum by (instance) (mem_usage)
+"""
+
+DUP_RULES = """
+groups:
+  - name: dup
+    interval: 30s
+    rules:
+      - record: app:a
+        expr: sum(rate(http_requests_total[5m]))
+      - record: app:b
+        expr: sum ( rate( http_requests_total[5m] ) )
+"""
+
+
+# ---------------------------------------------------------------------------
+# rules --check gains semantic diagnostics
+# ---------------------------------------------------------------------------
+
+def test_rules_check_rejects_semantic_errors(tmp_path):
+    """Acceptance fixture: rate() on a gauge-schema metric AND a
+    dropped-label vector match — both rejected, both with a spanned
+    diagnostic; the whole file fails to load."""
+    p = tmp_path / "bad.yaml"
+    p.write_text(BAD_RULES)
+    errors, _warnings = check_rules_file_full(str(p))
+    text = "\n".join(errors)
+    assert "promql-counter-fn-on-gauge" in text
+    assert "promql-match-on-dropped-label" in text
+    assert "^" in text          # caret spans in the rendering
+    assert "rate(app:mem:avg[5m])" in text
+    assert rules_main.main(["--check", str(p)]) == 1
+    with pytest.raises(RuleLoadError):
+        parse_rules_text(BAD_RULES)
+
+
+def test_rules_check_shipped_examples_sweep_clean():
+    errors, warnings = check_rules_file_full("examples/rules.yaml")
+    assert errors == [], errors
+    assert warnings == [], warnings
+    assert rules_main.main(["--check", "examples/rules.yaml"]) == 0
+
+
+def test_normalized_duplicate_detection():
+    """Whitespace/normalization-variant recording rules are caught by
+    parser-normalized comparison (raw text comparison would miss
+    them) — a warning, not a rejection."""
+    errors, warnings = [], []
+    parse_rules_text(DUP_RULES, errors=errors, warnings=warnings)
+    assert errors == []
+    assert any("semantically identical" in w for w in warnings), warnings
+
+
+def test_semantic_warnings_do_not_reject():
+    groups = load_groups({"groups": [{"name": "g", "rules": [
+        {"record": "x:delta",
+         "expr": "delta(http_requests_total[5m])"}]}]})
+    assert len(groups) == 1     # warning-severity finding only
+
+
+# ---------------------------------------------------------------------------
+# graftlint promql family
+# ---------------------------------------------------------------------------
+
+def test_promql_rules_registered_in_catalog():
+    from filodb_tpu.lint import rules
+    cat = rules()
+    fam = {rid: r for rid, r in cat.items()
+           if r.family == "promql"}
+    assert "promql-counter-fn-on-gauge" in fam
+    assert "promql-differential-mismatch" in fam
+    assert all(rid.startswith("promql-") for rid in fam)
+
+
+def test_rule_file_sweep_findings_and_github_flow(tmp_path):
+    """A broken rule file under examples/ becomes spanned findings
+    that flow through the --json/--github emitters with their
+    promql- rule ids."""
+    from filodb_tpu.lint import Finding, LintResult
+    from filodb_tpu.lint.ci_annotations import github_annotations
+    from filodb_tpu.lint.rules_promql import _rule_file_findings
+    root = tmp_path
+    ex = tmp_path / "examples"
+    ex.mkdir()
+    bad = ex / "bad.yaml"
+    bad.write_text(BAD_RULES)
+    found = _rule_file_findings(str(bad), str(root))
+    rules_seen = {f.rule for _rel, f in found}
+    assert "promql-counter-fn-on-gauge" in rules_seen
+    assert "promql-match-on-dropped-label" in rules_seen
+    by_rule = {f.rule: f for _rel, f in found}
+    f = by_rule["promql-counter-fn-on-gauge"]
+    assert f.path == "examples/bad.yaml"
+    assert f.line > 1           # anchored at the expr's line, not 1
+    res = LintResult(findings=[f for _rel, f in found])
+    lines = github_annotations(res.to_json())
+    assert any("::error" in l and "promql-counter-fn-on-gauge" in
+               urllib.parse.unquote(l.replace("%3A", ":")) or
+               "promql-counter-fn-on-gauge" in l for l in lines)
+
+
+def test_shipped_examples_sweep_clean_through_lint():
+    from filodb_tpu.lint import package_root
+    from filodb_tpu.lint.rules_promql import check_project
+    found = check_project([], package_root(), skip_soak=True)
+    assert found == [], [f.render() for _r, f in found]
+
+
+def test_changed_only_skips_differential_soak(monkeypatch):
+    from filodb_tpu.lint import rules_promql
+    called = []
+    monkeypatch.setattr(rules_promql, "_soak_findings",
+                        lambda root: called.append(root) or [])
+    rules_promql.check_project([], "/nonexistent", skip_soak=True)
+    assert called == []
+    rules_promql.check_project([], "/nonexistent", skip_soak=False)
+    assert called
+
+
+def test_differential_micro_soak_clean_and_under_perf_guard():
+    """The lint-gate soak arm: zero mismatches at the fixed seed, and
+    the FULL promql family sweep (rule files + soak) stays under the
+    30s budget — it runs inside every full lint invocation."""
+    from filodb_tpu.lint import package_root
+    from filodb_tpu.lint.rules_promql import check_project
+    t0 = time.perf_counter()
+    found = check_project([], package_root(), skip_soak=False)
+    elapsed = time.perf_counter() - t0
+    assert found == [], [f.render() for _r, f in found]
+    assert elapsed < 30.0, f"promql lint sweep took {elapsed:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: warnings + &lint=strict
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server():
+    from filodb_tpu.standalone.server import FiloServer
+    srv = FiloServer({"num-shards": 2, "grpc-port": None, "port": 0,
+                      "results-cache-mb": 0,
+                      "batch-enabled": False}).start()
+    srv.seed_dev_data(n_samples=60, n_instances=2,
+                      start_ms=1_600_000_000_000)
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _get(port, **params):
+    url = (f"http://127.0.0.1:{port}/promql/timeseries/api/v1/"
+           f"query_range?" + urllib.parse.urlencode(params))
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+T0 = 1_600_000_000
+_RANGE = dict(start=T0 + 100, end=T0 + 400, step=10)
+
+
+def test_http_lint_warnings_ride_the_envelope(server):
+    code, payload = _get(server.port,
+                         query="delta(http_requests_total[2m])",
+                         **_RANGE)
+    assert code == 200
+    warns = payload.get("warnings", [])
+    assert any("promql-gauge-fn-on-counter" in w for w in warns), \
+        payload.get("warnings")
+
+
+def test_http_lint_strict_rejects_with_diagnostics(server):
+    # lints as an error (dc was provably dropped by both sides'
+    # aggregations) yet still evaluates — both sides are single-series
+    # so the degenerate match is one-to-one
+    q = ("sum(rate(http_requests_total[2m])) * "
+         "on (dc) sum(heap_usage)")
+    code, payload = _get(server.port, query=q, lint="strict", **_RANGE)
+    assert code == 400
+    assert payload["errorType"] == "bad_data"
+    assert "promql-match-on-dropped-label" in payload["error"]
+    assert payload["lint"][0]["rule"].startswith("promql-")
+    assert payload["lint"][0]["pos"] >= 0
+    # non-strict: same query answers 200 with the finding as a warning
+    code2, payload2 = _get(server.port, query=q, **_RANGE)
+    assert code2 == 200
+    assert any("promql-match-on-dropped-label" in w
+               for w in payload2.get("warnings", []))
+
+
+def test_http_lint_off_disables(server):
+    code, payload = _get(server.port,
+                         query="delta(http_requests_total[2m])",
+                         lint="off", **_RANGE)
+    assert code == 200
+    assert not any("promlint" in w
+                   for w in payload.get("warnings", []))
+
+
+def test_http_lint_clean_query_untouched(server):
+    code, payload = _get(server.port,
+                         query="rate(http_requests_total[2m])",
+                         **_RANGE)
+    assert code == 200
+    assert not any("promlint" in w
+                   for w in payload.get("warnings", []))

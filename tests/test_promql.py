@@ -189,3 +189,118 @@ def test_parse_errors():
         parse("sum(")               # truncated
     with pytest.raises(ParseError):
         parse("foo{job=bar}")       # unquoted matcher value
+
+
+# ---------------------------------------------------------------------------
+# duration validation + zero windows (promlint satellite)
+# ---------------------------------------------------------------------------
+
+def test_malformed_durations_rejected():
+    for bad in ("", "5", "m5", "5mm", "1h2", "abc"):
+        with pytest.raises(ValueError):
+            parse_duration_ms(bad)
+    assert parse_duration_ms("0s") == 0     # zero itself parses
+
+
+def test_zero_window_rejected_with_span():
+    with pytest.raises(ParseError) as ei:
+        parse("rate(foo[0s])")
+    assert ei.value.pos == 9 and ei.value.end == 11
+    with pytest.raises(ParseError):
+        parse("rate(foo[0])")                # NUMBER-form zero too
+
+
+def test_zero_subquery_step_pinned():
+    """[5m:0s] — explicit zero resolution is rejected at parse time
+    (Prometheus rejects it too); [5m:] keeps the default step."""
+    with pytest.raises(ParseError) as ei:
+        parse("max_over_time(rate(foo[1m])[5m:0s])")
+    q = "max_over_time(rate(foo[1m])[5m:0s])"
+    assert q[ei.value.pos:ei.value.end] == "0s"
+    plan = parse("max_over_time(rate(foo[1m])[5m:])")
+    assert plan.sub_step_ms == 10_000       # query step
+
+
+# ---------------------------------------------------------------------------
+# ParseError span/position accuracy (promlint reuses these spans)
+# ---------------------------------------------------------------------------
+
+def _err_span(q):
+    with pytest.raises(ParseError) as ei:
+        parse(q)
+    return q, ei.value.pos, ei.value.end
+
+
+def test_span_quoted_labels_with_escapes():
+    # a bad matcher op AFTER an escaped-quote value: the escape must
+    # not shift the reported span
+    q = 'foo{job="a\\"b", x<"1"}'
+    _q, pos, end = _err_span(q)
+    assert q[pos:end] == "<"
+    # unterminated matcher block after a non-ASCII value: EOF position
+    q2 = 'foo{job="a\\"b", x="✓"'
+    _q, pos2, _ = _err_span(q2)
+    assert pos2 == len(q2)
+    # unquoted value span lands on the offending token
+    q3 = 'foo{job="ok", instance=i1}'
+    _q, pos3, end3 = _err_span(q3)
+    assert q3[pos3:end3] == "i1"
+
+
+def test_span_at_offset_combinations():
+    q = "rate(foo[5m] @ end() offset bad)"
+    _q, pos, end = _err_span(q)
+    assert q[pos:end] == "bad"
+    q2 = "1 offset 5m"
+    _q, pos2, _ = _err_span(q2)
+    assert q2[pos2:] == "offset 5m"
+    q3 = "(a + b) @ 1000"
+    _q, pos3, _ = _err_span(q3)
+    assert pos3 == q3.index("@")
+
+
+def test_span_utf8_metric_names():
+    # non-ASCII metric characters are rejected at their exact offset
+    q = "métrique"
+    with pytest.raises(ParseError) as ei:
+        parse(q)
+    assert ei.value.pos == 1                # the é
+    q2 = "sum(rate(日本語[5m]))"
+    with pytest.raises(ParseError) as ei2:
+        parse(q2)
+    assert ei2.value.pos == q2.index("日")
+
+
+def test_span_eof_and_trailing():
+    q = "sum(rate(foo[5m])"
+    with pytest.raises(ParseError) as ei:
+        parse(q)
+    assert ei.value.pos == len(q)           # at EOF
+    q2 = "foo bar"
+    with pytest.raises(ParseError) as ei2:
+        parse(q2)
+    assert q2[ei2.value.pos:ei2.value.end] == "bar"
+
+
+def test_ast_spans_cover_constructs():
+    from filodb_tpu.promql.parser import Parser, ast_span
+    q = "sum by (job) (rate(foo[5m] offset 1m))"
+    ast = Parser(q).parse()
+    assert ast_span(ast) == (0, len(q))
+    call = ast.expr
+    assert q[call.pos:call.end] == "rate(foo[5m] offset 1m)"
+    sel = call.args[0]
+    assert q[sel.pos:sel.end] == "foo[5m] offset 1m"
+
+
+def test_comments_are_whitespace():
+    plan = parse("rate(foo[5m])  # trailing comment")
+    assert isinstance(plan, lp.PeriodicSeriesWithWindowing)
+
+
+def test_normalize_query_canonical():
+    from filodb_tpu.promql.parser import normalize_query
+    a = normalize_query('sum by (job) (rate(x{b="2",a="1"}[5m]))')
+    b = normalize_query('sum ( rate( x{a="1", b="2"}[300s] ) ) by (job)')
+    assert a == b
+    assert normalize_query("a + b") != normalize_query("b + a")

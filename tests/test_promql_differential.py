@@ -1,0 +1,227 @@
+"""Type-driven differential correctness rail (promlint tentpole).
+
+Seeded well-typed queries (filodb_tpu.promql.gen) run through the REAL
+engine — oracle path and the results-cache path (cache on + off, cold
+and warm) — and through the deliberately slow pure-Python reference
+evaluator (filodb_tpu.promql.refeval). Any numeric/keyset discrepancy
+fails with the (seed, index, query) triple so it can be pinned.
+
+The two pinned tests at the bottom are REAL discrepancies this rail
+found during development; both were engine bugs and stay as named
+regression tests.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.promql.gen import QueryGen
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.promql.refeval import RefEvalError, RefSeries, ref_eval
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.model import GridResult, ScalarResult
+from filodb_tpu.query.planner import QueryPlanner
+from filodb_tpu.query.resultcache import ResultCache
+
+T0 = 1_600_000_000
+START, STEP, END = T0 + 900, 60, T0 + 2100
+
+SOAK_SEED = 0xD1FF
+SOAK_N = 200            # acceptance floor: >= 200 generated queries
+
+
+def _build():
+    """One shard of irregular synthetic data mirrored into RefSeries:
+    counters with gaps and one mid-stream reset, noisy gauges."""
+    shard = TimeSeriesShard(DatasetRef("timeseries"), DEFAULT_SCHEMAS, 0)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    rng = random.Random(7)
+    ref = []
+    for metric in ("http_requests_total", "errors_total"):
+        for job in ("api", "web"):
+            for inst in ("i0", "i1", "i2"):
+                labels = {"_metric_": metric, "_ws_": "demo",
+                          "_ns_": "App-0", "job": job, "instance": inst}
+                v = 0.0
+                ts, vals = [], []
+                for k in range(240):
+                    t = T0 + k * 10
+                    if rng.random() < 0.05:
+                        continue                    # scrape gap
+                    v += rng.random() * 5
+                    if metric == "errors_total" and inst == "i1" \
+                            and k == 150:
+                        v = rng.random()            # counter reset
+                    b.add_sample("prom-counter", labels, t * 1000, v)
+                    ts.append(t * 1000)
+                    vals.append(v)
+                ref.append(RefSeries(dict(labels), ts, vals))
+    for metric in ("cpu_usage", "queue_depth"):
+        for job in (("api", "web") if metric == "cpu_usage"
+                    else ("api",)):
+            for inst in ("i0", "i1", "i2"):
+                labels = {"_metric_": metric, "_ws_": "demo",
+                          "_ns_": "App-0", "job": job, "instance": inst}
+                ts, vals = [], []
+                for k in range(240):
+                    t = T0 + k * 10
+                    if rng.random() < 0.05:
+                        continue
+                    v = 50 * math.sin(k / 17.0) + rng.random() * 10 - 5
+                    b.add_sample("gauge", labels, t * 1000, v)
+                    ts.append(t * 1000)
+                    vals.append(v)
+                ref.append(RefSeries(dict(labels), ts, vals))
+    for c in b.containers():
+        shard.ingest(c)
+    shard.flush_all()
+    return shard, ref
+
+
+@pytest.fixture(scope="module")
+def world():
+    return _build()
+
+
+def _canon(res):
+    if isinstance(res, ScalarResult):
+        return {(): list(res.values)}
+    assert isinstance(res, GridResult), type(res)
+    out = {}
+    for i, k in enumerate(res.keys):
+        key = tuple(sorted(k.items()))
+        assert key not in out, f"duplicate engine key {key}"
+        out[key] = list(res.values[i])
+    return out
+
+
+def _close(a, b):
+    if math.isnan(a) and math.isnan(b):
+        return True
+    if math.isinf(a) or math.isinf(b):
+        return a == b
+    return abs(a - b) <= 1e-6 + 1e-6 * max(abs(a), abs(b))
+
+
+def _compare(tag, q, eng, rf):
+    assert set(eng) == set(rf), (
+        f"{tag}: series keysets differ for {q!r}:\n"
+        f"  engine only: {sorted(set(eng) - set(rf))[:3]}\n"
+        f"  ref only:    {sorted(set(rf) - set(eng))[:3]}")
+    for k in eng:
+        for j, (a, b) in enumerate(zip(eng[k], rf[k])):
+            assert _close(a, b), (
+                f"{tag}: {q!r} diverges at series {k} step {j}: "
+                f"engine={a!r} reference={b!r}")
+
+
+def test_differential_soak_engine(world):
+    """>= 200 seeded well-typed queries: engine oracle vs reference,
+    zero discrepancies (the tier-1 acceptance soak)."""
+    shard, ref = world
+    g = QueryGen(seed=SOAK_SEED)
+    for i in range(SOAK_N):
+        q = g.query()
+        plan = parse_query_range(q, TimeStepParams(START, STEP, END))
+        eng = _canon(QueryEngine([shard]).execute(plan))
+        rf = ref_eval(q, ref, START, STEP, END)
+        _compare(f"soak[{i}]", q, eng, rf)
+
+
+def _through_cache(shard, cache, q):
+    """One range evaluation through the results-cache split path (the
+    HTTP edge's plan -> begin -> materialize -> finish pipeline)."""
+    planner = QueryPlanner([shard])
+    plan = parse_query_range(q, TimeStepParams(START, STEP, END))
+    ses = cache.begin(planner, "timeseries", q, plan, START * 1000,
+                      STEP * 1000, END * 1000)
+    exs = [planner.materialize(p) for p in ses.plans]
+    return _canon(ses.finish(planner, [ex.execute() for ex in exs]))
+
+
+def test_differential_soak_result_cache(world):
+    """The same differential property through the results cache: cold
+    store, then a warm re-issue served from the cached extent — both
+    must match the reference bit-for-bit (the cache path must never
+    change an answer)."""
+    shard, ref = world
+    cache = ResultCache(max_bytes=32 << 20)
+    g = QueryGen(seed=SOAK_SEED + 1)
+    served = 0
+    for i in range(40):
+        q = g.query()
+        rf = ref_eval(q, ref, START, STEP, END)
+        cold = _through_cache(shard, cache, q)
+        warm = _through_cache(shard, cache, q)
+        _compare(f"cache-cold[{i}]", q, cold, rf)
+        _compare(f"cache-warm[{i}]", q, warm, rf)
+    served = cache.cached_steps_served
+    assert cache.hits > 0 and served > 0, (
+        "the warm pass never hit the results cache — the soak "
+        "stopped exercising the cache path", cache.hits, served)
+
+
+def test_differential_refeval_rejects_out_of_scope(world):
+    """The reference evaluator fails LOUDLY outside its scope instead
+    of silently passing a vacuous comparison."""
+    _shard, ref = world
+    with pytest.raises(RefEvalError):
+        ref_eval("topk(2, cpu_usage)", ref, START, STEP, END)
+
+
+# ---------------------------------------------------------------------------
+# pinned discrepancies — real engine bugs the rail found in development
+# ---------------------------------------------------------------------------
+
+def test_pinned_scalar_lhs_comparison_filter(world):
+    """PINNED (found by the differential rail): a filtering comparison
+    with the scalar on the LEFT (`0.25 <= queue_depth`) returned the
+    broadcast scalar instead of the vector's sample values. Prometheus
+    semantics: a filter comparison always yields the vector side."""
+    shard, ref = world
+    q = '0.25 <= queue_depth{job="api",instance="i0"}'
+    plan = parse_query_range(q, TimeStepParams(START, STEP, END))
+    eng = _canon(QueryEngine([shard]).execute(plan))
+    rf = ref_eval(q, ref, START, STEP, END)
+    _compare("pinned-scalar-lhs", q, eng, rf)
+    # and explicitly: every retained sample is a real gauge value from
+    # the selector, never the 0.25 literal
+    (vals,) = eng.values()
+    finite = [v for v in vals if not math.isnan(v)]
+    assert finite, "filter retained nothing — fixture drifted"
+    assert all(v >= 0.25 and v != 0.25 for v in finite)
+
+
+def test_pinned_nested_subquery_rebase(world):
+    """PINNED (found by the differential rail): lp_replace_range did
+    not rebase SubqueryWithWindowing, so a NESTED subquery kept its
+    parse-time grid and the enclosing subquery windowed over a
+    truncated inner range (first steps systematically wrong)."""
+    shard, ref = world
+    q = ('avg_over_time(last_over_time('
+         'http_requests_total{job="web",instance="i0"}[10m:])[6m:30s])')
+    plan = parse_query_range(q, TimeStepParams(START, STEP, END))
+    eng = _canon(QueryEngine([shard]).execute(plan))
+    rf = ref_eval(q, ref, START, STEP, END)
+    _compare("pinned-nested-subquery", q, eng, rf)
+
+
+def test_pinned_rebase_subquery_node_directly():
+    """The unit-level shape of the nested-subquery fix: rebasing a
+    SubqueryWithWindowing rewrites its outer grid."""
+    from filodb_tpu.query import logical as lp
+    from filodb_tpu.query.engine import lp_replace_range
+    raw = lp.RawSeriesPlan((), 0, 1000)
+    sub = lp.SubqueryWithWindowing(
+        lp.PeriodicSeries(raw, 0, 60_000, 1_000_000), "avg_over_time",
+        600_000, 60_000, 0, 60_000, 1_000_000)
+    moved = lp_replace_range(sub, 500_000, 30_000, 2_000_000)
+    assert (moved.start_ms, moved.step_ms, moved.end_ms) == \
+        (500_000, 30_000, 2_000_000)
+    assert moved.window_ms == 600_000 and moved.function == \
+        "avg_over_time"

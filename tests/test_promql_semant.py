@@ -1,0 +1,207 @@
+"""promlint semantic analyzer tests: type & schema checking, label
+dataflow, pragmas, spans (parity model: promtool check rules +
+Prometheus parser type-checking errors)."""
+
+import pytest
+
+from filodb_tpu.promql import semant as sm
+from filodb_tpu.promql.parser import ParseError, Parser
+
+
+def rules_of(q, schemas=None):
+    return [d.rule for d in sm.lint_query(q, schemas)]
+
+
+def errors_of(q, schemas=None):
+    return [d.rule for d in sm.errors(sm.lint_query(q, schemas))]
+
+
+# ---------------------------------------------------------------------------
+# type checking
+# ---------------------------------------------------------------------------
+
+def test_clean_queries():
+    for q in (
+            'sum(rate(http_requests_total[5m])) by (job)',
+            'histogram_quantile(0.9, sum by (le) (rate(b_bucket[5m])))',
+            'avg_over_time(rate(x_total[1m])[10m:1m])',
+            'clamp(cpu_usage, 0, 10) + 1',
+            'foo > bool 10',
+            '1 + 2 * 3',
+            'sum by (job) (a) / on (job) sum by (job) (b)',
+            'label_replace(up, "dst", "$1", "src", "(.*)")',
+    ):
+        assert rules_of(q) == [], q
+
+
+def test_range_fn_requires_range_vector():
+    assert "promql-range-arg" in errors_of("rate(foo)")
+    assert "promql-range-arg" in errors_of("sum(increase(foo))")
+
+
+def test_agg_requires_instant_vector():
+    assert "promql-instant-arg" in errors_of("sum(foo[5m])")
+    assert "promql-instant-arg" in errors_of("avg(2)")
+
+
+def test_top_level_range_vector_rejected():
+    assert "promql-top-level-range" in errors_of("foo[5m]")
+    assert "promql-top-level-range" in errors_of("foo[10m:1m]")
+
+
+def test_subquery_inner_must_be_instant():
+    assert "promql-subquery-inner" in errors_of(
+        "avg_over_time(foo[5m][10m:1m])")
+
+
+def test_bool_modifier_rules():
+    assert "promql-bool-modifier" in errors_of("a + bool b")
+    assert "promql-cmp-scalar-needs-bool" in errors_of("1 > 2")
+    assert errors_of("1 > bool 2") == []
+
+
+def test_set_op_operand_rules():
+    assert "promql-setop-operand" in errors_of("foo and 3")
+    assert "promql-setop-operand" in errors_of("2 or foo")
+
+
+def test_matching_with_scalar_rejected():
+    assert "promql-matching-with-scalar" in errors_of(
+        "foo * on (job) 3")
+
+
+def test_arity_checking():
+    assert "promql-arity" in errors_of("clamp(foo)")
+    assert "promql-arity" in errors_of("holt_winters(foo[5m], 0.5)")
+    assert "promql-arity" in errors_of("time(foo)")
+    assert errors_of("round(foo, 2)") == []
+
+
+def test_scalar_and_string_params():
+    assert "promql-scalar-arg" in errors_of(
+        "quantile_over_time(foo, bar[5m])")
+    assert "promql-string-arg" in errors_of(
+        "label_join(foo, bar, baz)")
+
+
+# ---------------------------------------------------------------------------
+# schema checking (counter/gauge semantics)
+# ---------------------------------------------------------------------------
+
+def test_counter_fn_on_declared_gauge_is_error():
+    s = sm.MetricSchemas({"heap_used": "gauge"})
+    assert "promql-counter-fn-on-gauge" in errors_of(
+        "rate(heap_used[5m])", s)
+    assert "promql-counter-fn-on-gauge" in errors_of(
+        "irate(heap_used[1m])", s)
+    # unknown metrics stay silent — a heuristic guess must not reject
+    assert errors_of("rate(some_unknown_metric[5m])") == []
+
+
+def test_gauge_fn_on_counter_warns():
+    diags = sm.lint_query("delta(http_requests_total[5m])")
+    assert [d.rule for d in diags] == ["promql-gauge-fn-on-counter"]
+    assert diags[0].severity == sm.WARNING
+    # declared counter too
+    s = sm.MetricSchemas({"reqs": "counter"})
+    assert "promql-gauge-fn-on-counter" in rules_of(
+        "deriv(reqs[5m])", s)
+
+
+def test_schema_resolution_sources():
+    s = sm.MetricSchemas({"x": "gauge"})
+    assert s.resolve("x") == ("gauge", True)
+    assert s.resolve("foo_total") == ("counter", False)
+    assert s.resolve("mystery") == (None, False)
+
+
+def test_from_rule_groups():
+    from filodb_tpu.rules.loader import load_groups
+    groups = load_groups({"groups": [
+        {"name": "g", "rules": [
+            {"record": "app:mem", "expr": "avg(mem)",
+             "schema": "gauge"}]}]})
+    s = sm.MetricSchemas.from_rule_groups(groups)
+    assert s.resolve("app:mem") == ("gauge", True)
+
+
+# ---------------------------------------------------------------------------
+# label dataflow
+# ---------------------------------------------------------------------------
+
+def test_match_on_dropped_label_is_error():
+    ds = sm.lint_query(
+        "sum by (job) (a) * on (instance) sum by (instance) (b)")
+    es = sm.errors(ds)
+    assert len(es) == 1 and es[0].rule == "promql-match-on-dropped-label"
+    assert "left-hand side" in es[0].message
+
+
+def test_without_keeps_labels_flowing():
+    assert errors_of(
+        "sum without (instance) (a) * on (job) b") == []
+
+
+def test_many_to_many_warning():
+    ds = sm.lint_query(
+        "sum by (job, instance) (a) / on (job) "
+        "sum by (job, instance) (b)")
+    assert [d.rule for d in ds] == ["promql-many-to-many"]
+    assert ds[0].severity == sm.WARNING
+    # a group modifier silences it
+    assert rules_of(
+        "sum by (job, instance) (a) / on (job) group_left "
+        "sum by (job) (b)") == []
+
+
+def test_include_dropped_label_warning():
+    assert "promql-include-dropped-label" in rules_of(
+        "a * on (job) group_left (version) sum by (job) (b)")
+
+
+def test_by_absent_label_warning():
+    assert "promql-by-absent-label" in rules_of(
+        "sum by (instance) (sum by (job) (a))")
+
+
+# ---------------------------------------------------------------------------
+# pragmas, spans, rendering
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_with_reason():
+    s = sm.MetricSchemas({"g": "gauge"})
+    q = ("rate(g[5m])  # promlint: disable=promql-counter-fn-on-gauge "
+         "(schema migration in flight)")
+    assert rules_of(q, s) == []
+
+
+def test_pragma_without_reason_is_finding():
+    q = "rate(g[5m])  # promlint: disable=promql-counter-fn-on-gauge"
+    assert "promql-pragma-no-reason" in rules_of(
+        q, sm.MetricSchemas({"g": "gauge"}))
+
+
+def test_pragma_unknown_rule_is_finding():
+    assert "promql-pragma-unknown-rule" in rules_of(
+        "up  # promlint: disable=promql-nonexistent (x)")
+
+
+def test_diagnostic_spans_point_at_the_construct():
+    q = "sum by (job) (a) * on (instance) sum by (instance) (b)"
+    (d,) = sm.errors(sm.lint_query(q))
+    # span anchors on the operator token of the join
+    assert q[d.pos] == "*"
+    r = d.render(q)
+    assert "^" in r and q in r
+
+
+def test_syntax_errors_become_spanned_diagnostics():
+    (d,) = sm.lint_query("sum(")
+    assert d.rule == "promql-syntax" and d.pos >= 0
+
+
+def test_rule_catalog_is_prefixed_and_documented():
+    for rid, (sev, doc) in sm.RULES.items():
+        assert rid.startswith("promql-")
+        assert sev in (sm.ERROR, sm.WARNING)
+        assert doc
