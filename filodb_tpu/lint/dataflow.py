@@ -106,7 +106,42 @@ class SpecInfo:
     arity: Optional[int] = None     # positional entries declared
     known: bool = False
     line: int = 0
-    bad_entries: Tuple[str, ...] = ()   # non-str/None constants
+    bad_entries: Tuple[str, ...] = ()   # non-str/int/None constants
+    # POSITIONAL axis indices (jax positional-PartitionSpec semantics:
+    # n = n-th mesh axis name, a single -1 = every axis not otherwise
+    # mentioned) — resolved against the site's mesh axis ORDER
+    pos_entries: Tuple[int, ...] = ()
+
+
+def resolve_positional(spec: "SpecInfo",
+                       order: Optional[Tuple[str, ...]]
+                       ) -> Tuple[Tuple[str, ...], List[str]]:
+    """(resolved axis names, problems) of a spec's positional entries
+    against an ordered mesh-axis tuple. With no order known, nothing
+    resolves and nothing is flagged; the -1-repeated and
+    out-of-range error cases mirror the runtime resolver
+    (parallel/mesh.resolve_spec)."""
+    problems: List[str] = []
+    if sum(1 for i in spec.pos_entries if i == -1) > 1:
+        problems.append("-1 appears more than once in one PartitionSpec")
+    if order is None:
+        return (), problems
+    names: List[str] = []
+    mentioned = set(spec.axes)
+    for i in spec.pos_entries:
+        if i != -1:
+            if not -len(order) <= i < len(order):
+                problems.append(
+                    f"positional index {i} out of range for mesh axes "
+                    f"{order}")
+            else:
+                mentioned.add(order[i])
+    for i in spec.pos_entries:
+        if i == -1:
+            names.extend(n for n in order if n not in mentioned)
+        elif -len(order) <= i < len(order):
+            names.append(order[i])
+    return tuple(names), problems
 
 
 def parse_spec(expr) -> SpecInfo:
@@ -118,24 +153,38 @@ def parse_spec(expr) -> SpecInfo:
         if leaf in ("P", "PartitionSpec"):
             axes: List[str] = []
             bad: List[str] = []
+            pos: List[int] = []
+
+            def harvest(el) -> None:
+                if isinstance(el, ast.UnaryOp) \
+                        and isinstance(el.op, ast.USub) \
+                        and isinstance(el.operand, ast.Constant) \
+                        and isinstance(el.operand.value, int) \
+                        and not isinstance(el.operand.value, bool):
+                    pos.append(-el.operand.value)   # e.g. the -1 form
+                    return
+                if not isinstance(el, ast.Constant):
+                    return      # Name/expr entries: unknown, still a P
+                v = el.value
+                if isinstance(v, str):
+                    axes.append(v)
+                elif isinstance(v, bool):
+                    bad.append(repr(v))
+                elif isinstance(v, int):
+                    pos.append(v)
+                elif v is not None:
+                    bad.append(repr(v))
+
             for a in expr.args:
-                if isinstance(a, ast.Constant):
-                    if isinstance(a.value, str):
-                        axes.append(a.value)
-                    elif a.value is not None:
-                        bad.append(repr(a.value))
-                elif isinstance(a, (ast.Tuple, ast.List)):
+                if isinstance(a, (ast.Tuple, ast.List)):
                     for el in a.elts:
-                        if isinstance(el, ast.Constant) \
-                                and isinstance(el.value, str):
-                            axes.append(el.value)
-                        elif isinstance(el, ast.Constant) \
-                                and el.value is not None:
-                            bad.append(repr(el.value))
-                # Name/expr entries: unknown, but the spec is still a P
+                        harvest(el)
+                else:
+                    harvest(a)
             return SpecInfo(axes=tuple(axes), arity=len(expr.args),
                             known=True, line=line,
-                            bad_entries=tuple(bad))
+                            bad_entries=tuple(bad),
+                            pos_entries=tuple(pos))
     return SpecInfo(line=line)
 
 
@@ -186,16 +235,23 @@ class MeshIndex:
         self.makers: Dict[str, Dict[str, Tuple[str, ...]]] = {}
         self.module_axes: Dict[str, Set[str]] = {}
         self.project_axes: Set[str] = set()
+        # module -> distinct ORDERED axis tuples of its Mesh literals:
+        # when a module declares exactly one order, positional
+        # PartitionSpec indices resolve against it
+        self.module_orders: Dict[str, Set[Tuple[str, ...]]] = {}
+        self.project_orders: Set[Tuple[str, ...]] = set()
         for mod in mods:
             dotted = cgmod.module_dotted(mod.relpath)
             mvars: Dict[str, Tuple[str, ...]] = {}
             makers: Dict[str, Tuple[str, ...]] = {}
             axes_here: Set[str] = set()
+            orders_here: Set[Tuple[str, ...]] = set()
             for node in ast.walk(mod.tree):
                 if isinstance(node, ast.Call):
                     axes = _mesh_axes_of_call(node)
                     if axes:
                         axes_here.update(axes)
+                        orders_here.add(axes)
                 if isinstance(node, ast.Assign) and \
                         isinstance(node.value, ast.Call):
                     axes = _mesh_axes_of_call(node.value)
@@ -215,6 +271,19 @@ class MeshIndex:
             self.makers[dotted] = makers
             self.module_axes[dotted] = axes_here
             self.project_axes |= axes_here
+            self.module_orders[dotted] = orders_here
+            self.project_orders |= orders_here
+
+    def axis_order(self, module: str) -> Optional[Tuple[str, ...]]:
+        """The unambiguous ordered axis tuple positional PartitionSpec
+        indices resolve against: the module's single declared order,
+        falling back to the project's single order, else None."""
+        orders = self.module_orders.get(module) or set()
+        if len(orders) == 1:
+            return next(iter(orders))
+        if not orders and len(self.project_orders) == 1:
+            return next(iter(self.project_orders))
+        return None
 
     def resolve(self, module: str, expr,
                 local_assigns: Dict[str, ast.AST]) -> Optional[Tuple[str, ...]]:
@@ -468,10 +537,19 @@ class DeviceDataflow:
 
     # -- closures + axis env -------------------------------------------------
 
+    def site_order(self, site: SpmdSite) -> Optional[Tuple[str, ...]]:
+        """Ordered mesh axes positional spec indices resolve against at
+        this site."""
+        return site.mesh_axes or self.mesh.axis_order(site.module)
+
     def site_axes(self, site: SpmdSite) -> Set[str]:
         axes: Set[str] = set(site.mesh_axes or ())
+        order = self.site_order(site)
         for s in site.all_specs:
             axes |= set(s.axes)
+            if s.pos_entries:
+                names, _ = resolve_positional(s, order)
+                axes |= set(names)
         if not axes:
             axes |= self.mesh.module_axes.get(site.module, set())
         if not axes:

@@ -290,14 +290,29 @@ def _check_specs(df: dfmod.DeviceDataflow, mods: Sequence[ModuleSource]
         allowed = set(site.mesh_axes or ()) \
             or df.mesh.module_axes.get(site.module, set()) \
             or df.mesh.project_axes
+        order = df.site_order(site)
         for spec in site.all_specs:
             for bad in spec.bad_entries:
                 out.append((site.relpath, Finding(
                     rule="partition-spec-consistency", path=site.relpath,
                     line=spec.line or site.line,
                     message=(f"PartitionSpec entry {bad} is neither an "
-                             f"axis-name string nor None"),
+                             f"axis-name string, a positional axis "
+                             f"index, nor None"),
                     context=f"spec:{site.relpath}:{bad}")))
+            # positional indices (jax positional-PartitionSpec
+            # semantics): resolve against the site's mesh axis order;
+            # out-of-range indices and a repeated -1 are the same
+            # run-time errors the named-axis checks catch at lint time
+            if spec.pos_entries:
+                _res, problems = dfmod.resolve_positional(spec, order)
+                for why in problems:
+                    out.append((site.relpath, Finding(
+                        rule="partition-spec-consistency",
+                        path=site.relpath,
+                        line=spec.line or site.line,
+                        message=f"PartitionSpec positional entry: {why}",
+                        context=f"spec-pos:{site.relpath}:{why}")))
             if allowed:
                 for a in spec.axes:
                     if a not in allowed:
@@ -546,14 +561,21 @@ def _check_donation(df: dfmod.DeviceDataflow,
                 root = _attr_root_dotted(e)
                 if root is not None:
                     stmt_target = None
-                    # refresh idiom: same attribute rebound from result
+                    # refresh idiom: same attribute rebound from the
+                    # result in the same statement — including the
+                    # MULTI-BUFFER form `self.a, self.b = step(self.a,
+                    # self.b, ...)` (tuple targets), the donated
+                    # tile-refresh shape
                     parent = getattr(e, "_filo_parent_stmt", None)
                     if parent is None:
                         parent = _enclosing_assign(fi.node, node)
                     if parent is not None:
                         for t in parent.targets:
-                            if dfmod._dotted(t) == root:
-                                stmt_target = root
+                            elts = t.elts if isinstance(
+                                t, (ast.Tuple, ast.List)) else (t,)
+                            for el in elts:
+                                if dfmod._dotted(el) == root:
+                                    stmt_target = root
                     if stmt_target is None:
                         emit(fi, node,
                              f"donates {root!r}, which live state "

@@ -121,10 +121,6 @@ class SelfMonitor:
         self._m_series = reg.gauge(
             "filodb_selfmon_series_last_tick",
             "Distinct internal series written by the last tick")
-        self._m_age = reg.gauge(
-            "filodb_selfmon_last_tick_age_seconds",
-            "Seconds since the last completed self-monitoring tick "
-            "(the loop's own freshness watermark)")
         reg.register_collector(self._collect_age)
 
     # -- lifecycle ---------------------------------------------------------
@@ -144,10 +140,18 @@ class SelfMonitor:
         return self._thread is not None and self._thread.is_alive()
 
     def _collect_age(self, builder) -> None:
+        # sample straight into the CURRENT build (a gauge family set
+        # here would only surface in the NEXT exposition — racy when a
+        # scrape lands between the first completed tick and the next
+        # build's collector phase)
         with self._lock:
             last = self.last_tick_monotonic
         if last is not None:
-            self._m_age.set(round(time.monotonic() - last, 3))
+            builder.sample(
+                "filodb_selfmon_last_tick_age_seconds", {},
+                round(time.monotonic() - last, 3), mtype="gauge",
+                help="Seconds since the last completed self-monitoring "
+                     "tick (the loop's own freshness watermark)")
 
     @thread_root("selfmon-loop")
     def _run(self) -> None:

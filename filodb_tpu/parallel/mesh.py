@@ -38,13 +38,95 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 # jax moved shard_map to the top level (and renamed check_rep -> check_vma)
 # after 0.4.x; accept either so the mesh executor runs on both
 if hasattr(jax, "shard_map"):
-    _shard_map = jax.shard_map
+    _shard_map_raw = jax.shard_map
 else:                                                   # jax <= 0.4.x
     from jax.experimental.shard_map import shard_map as _shard_map_legacy
 
-    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    def _shard_map_raw(f, *, mesh, in_specs, out_specs, check_vma=True):
         return _shard_map_legacy(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_rep=check_vma)
+
+
+def resolve_spec(mesh: "Mesh", spec):
+    """Expand positional PartitionSpec indices against ``mesh``.
+
+    Library-level shard_map code (tilestore/shardstore evaluators) names
+    mesh axes POSITIONALLY — ``P(0)`` is the first mesh axis, ``P(1)``
+    the second, a single ``-1`` the tuple of all axes not otherwise
+    mentioned (dropped when empty) — so the evaluator bodies stay
+    agnostic to users' axis naming conventions. Newer jax resolves these
+    natively; this resolver implements the same semantics on every
+    version this repo supports. Out-of-range indices and a repeated
+    ``-1`` raise ValueError, mirroring the native behavior."""
+    if spec is None:
+        return spec
+    names = tuple(mesh.axis_names)
+    entries = tuple(spec)
+
+    def subaxes(e):
+        return tuple(e) if isinstance(e, (tuple, list)) else (e,)
+
+    if not any(isinstance(x, int) for e in entries for x in subaxes(e)):
+        return spec
+
+    def name_of(i: int) -> str:
+        if not -len(names) <= i < len(names):
+            raise ValueError(
+                f"positional PartitionSpec index {i} out of range for "
+                f"mesh axes {names}")
+        return names[i]
+
+    mentioned = set()
+    for e in entries:
+        for x in subaxes(e):
+            if isinstance(x, str):
+                mentioned.add(x)
+            elif isinstance(x, int) and x != -1:
+                mentioned.add(name_of(x))
+    neg = sum(1 for e in entries for x in subaxes(e)
+              if isinstance(x, int) and x == -1)
+    if neg > 1:
+        raise ValueError("at most one -1 may appear in a PartitionSpec")
+    remaining = tuple(n for n in names if n not in mentioned)
+    out = []
+    for e in entries:
+        if isinstance(e, int):
+            if e == -1:
+                out.append(remaining if remaining else None)
+            else:
+                out.append(name_of(e))
+        elif isinstance(e, (tuple, list)):
+            sub = []
+            for x in e:
+                if isinstance(x, int):
+                    sub.extend(remaining if x == -1 else (name_of(x),))
+                else:
+                    sub.append(x)
+            out.append(tuple(sub))
+        else:
+            out.append(e)
+    return P(*out)
+
+
+def _resolve_spec_tree(mesh, specs):
+    """resolve_spec over a specs pytree (tuples/lists/dicts of P/None)."""
+    if specs is None or isinstance(specs, P):
+        return resolve_spec(mesh, specs)
+    if isinstance(specs, (tuple, list)):
+        return tuple(_resolve_spec_tree(mesh, s) for s in specs)
+    if isinstance(specs, dict):
+        return {k: _resolve_spec_tree(mesh, v) for k, v in specs.items()}
+    return specs
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map with positional-PartitionSpec resolution: mesh-agnostic
+    library specs (P(0), P(None, 1), P(-1)) expand against the call's
+    mesh before lowering."""
+    return _shard_map_raw(f, mesh=mesh,
+                          in_specs=_resolve_spec_tree(mesh, in_specs),
+                          out_specs=_resolve_spec_tree(mesh, out_specs),
+                          check_vma=check_vma)
 
 from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
@@ -361,12 +443,18 @@ class MeshExecutor:
          S) = self._prepare_inputs(series_by_shard, params, func,
                                    window_ms, group_ids_by_shard,
                                    offset_ms)
-        self._note_exec(("topk", func, int(k), bool(bottom), t_local,
-                         tuple(ts.shape)))
+        sc = float(func_args[0]) if func_args else 0.0
+        self._note_exec(
+            ("topk", func, int(k), bool(bottom), t_local,
+             tuple(ts.shape), self.ndev),
+            probe=self._cost_probe(self._step_topk,
+                                   (func, num_groups, int(k),
+                                    bool(bottom), t_local, w_bound),
+                                   (ts, vals, lens, gids),
+                                   (w0s, w0e, step, sc)))
         out_v, out_i = self._step_topk(
             func, num_groups, int(k), bool(bottom), t_local,
-            w_bound, ts, vals, lens, gids, w0s, w0e, step,
-            float(func_args[0]) if func_args else 0.0)
+            w_bound, ts, vals, lens, gids, w0s, w0e, step, sc)
         return np.asarray(out_v)[:, :T], np.asarray(out_i)[:, :T], S
 
     def window_aggregate(self,
@@ -389,21 +477,53 @@ class MeshExecutor:
          _) = self._prepare_inputs(series_by_shard, params, func,
                                    window_ms, group_ids_by_shard,
                                    offset_ms)
-        self._note_exec(("agg", func, agg, t_local, tuple(ts.shape)))
+        sc = float(func_args[0]) if func_args else 0.0
+        self._note_exec(
+            ("agg", func, agg, t_local, tuple(ts.shape), self.ndev),
+            probe=self._cost_probe(self._step,
+                                   (func, agg, num_groups, t_local,
+                                    w_bound),
+                                   (ts, vals, lens, gids),
+                                   (w0s, w0e, step, sc)))
         out = self._step(func, agg, num_groups,
                          t_local, w_bound, ts, vals, lens, gids,
-                         w0s, w0e, step,
-                         float(func_args[0]) if func_args else 0.0)
+                         w0s, w0e, step, sc)
         return np.asarray(out)[:, :T]
 
-    def _note_exec(self, key) -> None:
+    @property
+    def ndev(self) -> int:
+        """Device count of the executor's mesh — the per-(kernel,
+        device-count) attribution atom every mesh executable key
+        carries, so /metrics and &explain=analyze show 1/2/4/8-device
+        compiles of the same kernel as distinct executables."""
+        return int(self.mesh.devices.size)
+
+    @staticmethod
+    def _cost_probe(jitted, statics, arrays, scalars):
+        """() -> Compiled lazy cost probe over the abstract signature
+        (the tilestore AOT pattern, deferred: the first
+        &explain=analyze touching the executable pays the compile,
+        serving dispatches never do). Closes over ShapeDtypeStructs,
+        never the tiles themselves."""
+        abstract = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in (np.asarray(x) for x in arrays))
+        consts = tuple(np.asarray(s) for s in scalars)
+
+        def probe():
+            return jitted.lower(*statics, *abstract, *consts).compile()
+        return probe
+
+    def _note_exec(self, key, probe=None) -> None:
         """Compile/dispatch telemetry for the mesh-executable cache
-        (obs/devprof.py): per (kernel, static shape) key — first sight
-        is the shard_map trace + pjit compile, later dispatches reuse
-        the jit cache. Feeds filodb_executable_* families and the
-        &explain=analyze executable attribution."""
+        (obs/devprof.py): per (kernel, static shape, device count) key
+        — first sight is the shard_map trace + pjit compile (and
+        registers the lazy cost probe for XLA cost_analysis capture),
+        later dispatches reuse the jit cache. Feeds the
+        filodb_executable_* families and the &explain=analyze
+        executable attribution."""
         from filodb_tpu.obs import devprof
         first = key not in self._exec_seen
         if first:
             self._exec_seen.add(key)
-        devprof.note_dispatch("mesh", key, first)
+        devprof.note_dispatch("mesh", key, first,
+                              probe=probe if first else None)

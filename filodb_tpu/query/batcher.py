@@ -281,11 +281,22 @@ class MicroBatcher:
     # -- admission --------------------------------------------------------
     @hot_path
     def submit(self, key: object, member: object,
-               run_batch: Callable[[Sequence[object]], SplitResult]
-               ) -> np.ndarray:
+               run_batch: Callable[[Sequence[object]], SplitResult],
+               use_executor: Optional[bool] = None) -> np.ndarray:
         """Join (or open) the batch for ``key``; returns this member's
-        split of the batch result."""
+        split of the batch result.
+
+        ``use_executor`` overrides the batcher-wide executor choice for
+        this batch key: mesh-sharded dispatches pass True so ONE thread
+        owns multi-device submission even on the CPU backend — a
+        sharded program already spans every device, and N query threads
+        running sharded programs inline would only oversubscribe the
+        per-device compute threads (single-device CPU dispatches keep
+        the inline path: there, per-thread execution IS the
+        parallelism)."""
         prio = qos.current_priority()
+        exec_here = self.use_executor if use_executor is None \
+            else bool(use_executor)
         if not self.enabled:
             res = run_batch([member])
             self.stats.record(1, 0, prio)
@@ -318,7 +329,7 @@ class MicroBatcher:
             obs_metrics.observe("filodb_batcher_queue_wait_seconds",
                                 _QWAIT_HELP, 0.0)
             return self._execute(key, p, run_batch, queued=False)
-        if self.use_executor:
+        if exec_here:
             # leader under concurrency: queue the OPEN batch — arrivals
             # keep joining until the executor picks it up (its busy
             # time is the gather window), then park on the future.

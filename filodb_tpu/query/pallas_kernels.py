@@ -107,6 +107,14 @@ _GS_TT = 256           # query steps per tile (sublane dim of compute):
 #                        256 halves the sequential-grid iteration count
 #                        vs 128 — the loop is scalar-core/DMA-issue
 #                        bound, so fewer, larger tiles win
+_GS_TT_WIDE = 512      # widened step tile: picked per query by
+#                        _gs_pipeline when the [T, G] accumulators +
+#                        DMA scratch still fit the VMEM budget — halves
+#                        the sequential grid again for long ranges
+_GS_NBUF_MAX = 3       # deepest DMA pipeline: triple-buffered scratch
+#                        keeps the DMA engine (nbuf-1) tiles ahead, so
+#                        the HBM read of tile g+2 overlaps tile g's
+#                        compute ACROSS sequential-program boundaries
 
 _GS_SS = 512           # series per tile (lane dim)
 _GS_AL = 8             # sublane alignment Mosaic requires of HBM slices
@@ -150,14 +158,45 @@ def _gs_ablate_active(interpret: bool) -> frozenset:
     return _GS_ABLATE
 
 
-def _gs_mlen(st: int, dspan: int) -> int:
+def _gs_mlen(st: int, dspan: int, tt: int = _GS_TT) -> int:
     lead = 1 if st == 1 else 0
-    return _GS_TT + _GS_AL + (-(-(dspan + lead) // _GS_AL)) * _GS_AL
+    return tt + _GS_AL + (-(-(dspan + lead) // _GS_AL)) * _GS_AL
+
+
+def _gs_nstreams(st: int, hi_mode: int, lo_mode: int) -> int:
+    return 1 + (1 if hi_mode != GS_CUR and st != 1 else 0) \
+        + (1 if lo_mode != GS_CUR and st != 1 else 0)
+
+
+def _gs_pipeline(st: int, dspan: int, hi_mode: int, lo_mode: int,
+                 nsteps: int, G: int,
+                 vmem_budget: int = 14 << 20) -> Optional[Tuple[int, int]]:
+    """(tt, nbuf) for one kernel build, or None when no configuration
+    fits the VMEM budget: prefer the WIDER step tile (fewer sequential
+    grid iterations — the loop is scalar-core/DMA-issue bound), then
+    the DEEPER DMA pipeline (prefetch distance nbuf-1 overlaps HBM
+    reads with compute across program boundaries). The budget covers
+    accumulators + scratch + onehot/base input blocks — the full
+    on-chip footprint, so an inadmissible query falls back on the host
+    instead of exploding at Mosaic compile time."""
+    nstreams = _gs_nstreams(st, hi_mode, lo_mode)
+    fixed = _GS_SS * G * 4 + 8 * _GS_SS * 4          # onehot + base
+    for tt in (_GS_TT_WIDE, _GS_TT):
+        if tt != _GS_TT and nsteps <= _GS_TT:
+            continue                                 # nothing to widen
+        t_pad = -(-nsteps // tt) * tt
+        accum = 2 * t_pad * G * 4
+        mlen = _gs_mlen(st, dspan, tt)
+        for nbuf in range(_GS_NBUF_MAX, 1, -1):
+            scratch = nbuf * nstreams * mlen * 3 * _GS_SS * 4
+            if accum + scratch + fixed <= vmem_budget:
+                return tt, nbuf
+    return None
 
 
 def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
                      lo_mode: int, exact_branch: bool, n_ttiles: int,
-                     mlen: int, ablate: frozenset,
+                     mlen: int, tt: int, nbuf: int, ablate: frozenset,
                      params_ref, v_ref, base_ref, oh_ref,
                      sum_ref, cnt_ref, v_scr, sems):
     """Grid: (n_s,) sequential. params (SMEM, i32):
@@ -185,7 +224,7 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
 
     def dmas(si_, slot, ti):
         out = []
-        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * _GS_TT - lead
+        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * tt - lead
         g8m = pl.multiple_of((g_m // _GS_AL) * _GS_AL, _GS_AL)
         # the permuted G axis is padded past every tail tile
         # (t_perm_tiled), so blocks stay in bounds; dead rows are masked
@@ -200,12 +239,12 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
                               (need3, idx3, kl0 + 1)):
             if not need:
                 continue
-            g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
+            g = jax.lax.div(kf, jnp.int32(st)) + ti * tt
             g8 = pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
             out.append(pltpu.make_async_copy(
                 v_ref.at[si_, jax.lax.rem(kf, jnp.int32(st)),
-                         pl.ds(g8, _GS_TT + _GS_AL), :],
-                v_scr.at[slot, idx, pl.ds(0, _GS_TT + _GS_AL)],
+                         pl.ds(g8, tt + _GS_AL), :],
+                v_scr.at[slot, idx, pl.ds(0, tt + _GS_AL)],
                 sems.at[slot, idx]))
         return out
 
@@ -213,36 +252,42 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
     def _():
         sum_ref[:] = jnp.zeros_like(sum_ref)
         cnt_ref[:] = jnp.zeros_like(cnt_ref)
-        for d in dmas(0, 0, 0):
-            d.start()
+        # pipeline warm-up: fill nbuf-1 scratch slots ahead (global
+        # tiles 0..nbuf-2, crossing program boundaries for tiny grids)
+        for g in range(nbuf - 1):
+
+            @pl.when(jnp.int32(g) < n_s * n_ttiles)
+            def _(g=g):
+                for d in dmas(jnp.int32(g // n_ttiles), g % nbuf,
+                              jnp.int32(g % n_ttiles)):
+                    d.start()
 
     def t_loop(ti, _):
         gti = si * n_ttiles + ti
-        slot = jax.lax.rem(gti, 2)
-        nxt = jax.lax.rem(gti + 1, 2)
+        slot = jax.lax.rem(gti, nbuf)
 
-        # prefetch the next tile — crossing into the next program's
-        # first tile at tile boundaries, so the DMA engine never idles
-        # between sequential grid programs
-        @pl.when(ti + 1 < n_ttiles)
-        def _():
-            for d in dmas(si, nxt, ti + 1):
-                d.start()
+        # keep the DMA engine nbuf-1 tiles AHEAD — prefetching across
+        # sequential-program boundaries, so the HBM read of tile
+        # g+nbuf-1 overlaps tile g's compute and the engine never
+        # idles between grid programs
+        gn = gti + (nbuf - 1)
 
-        @pl.when((ti + 1 == n_ttiles) & (si + 1 < n_s))
+        @pl.when(gn < n_s * n_ttiles)
         def _():
-            for d in dmas(si + 1, nxt, 0):
+            for d in dmas(jax.lax.div(gn, jnp.int32(n_ttiles)),
+                          jax.lax.rem(gn, jnp.int32(nbuf)),
+                          jax.lax.rem(gn, jnp.int32(n_ttiles))):
                 d.start()
         for d in dmas(si, slot, ti):
             d.wait()
 
-        gt = ti * _GS_TT + jax.lax.broadcasted_iota(
-            jnp.int32, (_GS_TT, 1), 0)                     # [TT, 1]
+        gt = ti * tt + jax.lax.broadcasted_iota(
+            jnp.int32, (tt, 1), 0)                         # [TT, 1]
         live = gt < T
         wend_r = w0e_rel + gt * step
         wstart_r = wend_r - window
 
-        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * _GS_TT - lead
+        g_m = jax.lax.div(kl0, jnp.int32(st)) + ti * tt - lead
         g8m = pl.multiple_of((g_m // _GS_AL) * _GS_AL, _GS_AL)
         offm = g_m - g8m
         # ONE dynamic roll; every family view is a STATIC slice of it
@@ -255,16 +300,16 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
             R = pltpu.roll(v_scr[slot, 0], shift=mlen - offm, axis=0)
 
         def view(row0):
-            return R[row0:row0 + _GS_TT]
+            return R[row0:row0 + tt]
 
         def fam_view(idx, kf):
-            full = v_scr[slot, idx, :_GS_TT + _GS_AL]
+            full = v_scr[slot, idx, :tt + _GS_AL]
             if "noroll" in ablate:
-                return full[:_GS_TT]
-            g = jax.lax.div(kf, jnp.int32(st)) + ti * _GS_TT
+                return full[:tt]
+            g = jax.lax.div(kf, jnp.int32(st)) + ti * tt
             off = g - pl.multiple_of((g // _GS_AL) * _GS_AL, _GS_AL)
-            return pltpu.roll(full, shift=(_GS_TT + _GS_AL) - off,
-                              axis=0)[:_GS_TT]
+            return pltpu.roll(full, shift=(tt + _GS_AL) - off,
+                              axis=0)[:tt]
 
         def planes(v):
             return (v[:, :_GS_SS], v[:, _GS_SS:2 * _GS_SS],
@@ -361,7 +406,7 @@ def _groupsum_kernel(func: str, st: int, dspan: int, hi_mode: int,
         local = jnp.where(ok, out, jnp.float32(0.0))
         okf = jnp.where(ok, jnp.float32(1.0), jnp.float32(0.0))
         oh = oh_ref[:]
-        sl = pl.ds(ti * _GS_TT, _GS_TT)
+        sl = pl.ds(ti * tt, tt)
         if "nodot" in ablate:
             sum_ref[sl, :] += local[:, :16]
             cnt_ref[sl, :] += okf[:, :16]
@@ -419,8 +464,11 @@ def _groupsum_expect(out):
               index_map=lambda si: (si, 0)),
     ),
     scratch=(
-        # double-buffered merged-stream DMA scratch: 2 slots x 3
-        # streams x mlen(st=2, dspan=48)=312 rows x 3 planes
+        # worst-case ADMISSIBLE DMA scratch on the (step-tile width,
+        # pipeline depth) frontier _gs_pipeline walks: 2 slots x 3
+        # streams x mlen(st=2, dspan=48, tt=256)=312 rows x 3 planes
+        # (wider tiles / deeper pipelines are only chosen in cheaper
+        # stream configurations — the chooser keeps the total <= 14MB)
         Block("v_scr", (2, 3, 312, 3 * _GS_SS), "int32"),
         Block("sems", (2, 3), "int32", space=SEM),
     ),
@@ -463,9 +511,16 @@ def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
     n_s = v_p.shape[0]
     G = onehot.shape[1]
     assert onehot.shape[0] == n_s * _GS_SS, (onehot.shape, n_s)
-    T_pad = -(-nsteps // _GS_TT) * _GS_TT
-    n_ttiles = T_pad // _GS_TT
-    mlen = _gs_mlen(st, dspan)
+    # step-tile width + DMA pipeline depth for this query shape: widen
+    # to _GS_TT_WIDE / deepen to triple-buffering whenever the on-chip
+    # footprint allows (callers pre-check _gs_pipeline; this assert is
+    # the contract)
+    pipe = _gs_pipeline(st, dspan, hi_mode, lo_mode, nsteps, G)
+    assert pipe is not None, "caller must gate on _gs_pipeline"
+    tt, nbuf = pipe
+    T_pad = -(-nsteps // tt) * tt
+    n_ttiles = T_pad // tt
+    mlen = _gs_mlen(st, dspan, tt)
     if exact_branch is None:
         # integer extrapolation-branch products must fit i32
         exact_branch = 11 * int(window) * (dspan * st + 1) < 2 ** 31
@@ -492,14 +547,14 @@ def counter_groupsum(func: str, st: int, dspan: int, hi_mode: int,
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, nstreams, mlen, 3 * _GS_SS), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, nstreams)),
+            pltpu.VMEM((nbuf, nstreams, mlen, 3 * _GS_SS), jnp.int32),
+            pltpu.SemaphoreType.DMA((nbuf, nstreams)),
         ],
     )
 
     def body(params, v_p, base, onehot, *, _k=functools.partial(
             _groupsum_kernel, func, st, dspan, hi_mode, lo_mode,
-            bool(exact_branch), n_ttiles, mlen,
+            bool(exact_branch), n_ttiles, mlen, tt, nbuf,
             _gs_ablate_active(interpret))):
         def kern(params_ref, v_ref, base_ref, oh_ref,
                  sum_ref, cnt_ref, v_scr, sems):

@@ -359,6 +359,50 @@ class LocalEngineExec(ExecPlan):
 
 
 @dataclass
+class MeshTileExec(ExecPlan):
+    """A tilestore-servable shape lowered onto the device-RESIDENT
+    sharded tile path: the bare windowed counter/aligned shape
+    (rangefunc(selector[w]), instant or range) and the fused grouped
+    shape (sum/count/avg by of rate/increase/delta). Evaluation runs
+    through the normal engine over the local shards, and the backend's
+    sharded tile evaluator (TpuBackend.mesh_eval,
+    parallel/shardstore.py) dispatches the slot-major evaluator under
+    shard_map — series on the 'shard' axis, output step-grid slices on
+    the 'time' axis, grouped reduction as the one-hot matmul + psum
+    collective — from tiles already living in device HBM (no per-query
+    re-pack, unlike MeshAggregateExec's scatter-gather). Per-series
+    response bytes are identical to the single-device path by
+    construction (the sharded program computes the same evaluator body
+    element values bit-for-bit); this node pins the shapes the sharded
+    store serves at plan time and surfaces the mesh disposition in
+    plan trees/explain."""
+    plan: object
+    shards: Sequence[object]
+    backend: Optional[object]
+    stats: QueryStats
+    limits: Optional[QueryLimits] = None
+
+    def execute(self):
+        eng = QueryEngine(self.shards, backend=self.backend,
+                          limits=self.limits)
+        out = eng.execute(self.plan)
+        self.stats.add(eng.stats)
+        if isinstance(out, GridResult) and eng.stats.partial:
+            out.partial = True
+            out.warnings.extend(w for w in eng.stats.warnings
+                                if w not in out.warnings)
+        return out
+
+    def plan_tree(self, indent: int = 0) -> str:
+        pads = " " * indent
+        shard_nums = [getattr(s, "shard_num", "?") for s in self.shards]
+        shape = getattr(self.plan, "op", None) \
+            or getattr(self.plan, "function", None)
+        return (f"{pads}MeshTileExec(shape={shape}, "
+                f"shards={shard_nums})")
+
+
+@dataclass
 class MeshAggregateExec(ExecPlan):
     """agg(rangefunc(selector[w])) by (labels) on the device mesh.
 
@@ -1189,9 +1233,12 @@ class QueryPlanner:
             raw_exec = self._materialize_raw(raw_plan)
         return StitchExec(ds_exec=ds_exec, raw_exec=raw_exec)
 
-    def _try_mesh_lowering(self, plan) -> Optional[MeshAggregateExec]:
+    def _try_mesh_lowering(self, plan) -> Optional[ExecPlan]:
         from filodb_tpu.query.tpu import DEVICE_FUNCS
 
+        window = self._try_mesh_window(plan)
+        if window is not None:
+            return window
         if self.mesh is None:
             return None
         topk = plan.op in ("topk", "bottomk") if isinstance(
@@ -1240,6 +1287,19 @@ class QueryPlanner:
                 return None
         if topk and hist_kind != "none":
             return None
+        # prefer the device-RESIDENT tile path over scatter-gather for
+        # the fused grouped shape: the engine's fused_groupsum routes
+        # to the sharded one-hot-matmul + psum collective off tiles
+        # already living in HBM (falling back in-engine when the
+        # cohort doesn't qualify) — re-pack-per-query is the dry-run
+        # design, not the serving path
+        if not topk and hist_kind == "none" \
+                and plan.op in ("sum", "count", "avg") \
+                and not plan.params \
+                and self.backend is not None \
+                and getattr(self.backend, "mesh_eval", None) is not None:
+            return MeshTileExec(plan, shards, self.backend, self.stats,
+                                self.limits)
         return MeshAggregateExec(
             agg_op=plan.op, by=tuple(plan.by),
             without=tuple(plan.without), agg_params=tuple(plan.params),
@@ -1250,6 +1310,39 @@ class QueryPlanner:
             raw=raw, shards=shards, mesh_executor=self.mesh,
             stats=self.stats, limits=self.limits, hist_les=hist_les,
             deadline=self.deadline)
+
+    def _try_mesh_window(self, plan) -> Optional[MeshTileExec]:
+        """The bare windowed shape (instant/range rangefunc over a raw
+        selector — the tilestore counter path) lowers for mesh
+        execution when the backend serves device-resident sharded
+        tiles. The historical mesh lowering only caught the
+        scatter-gather aggregate shape; this covers the per-series
+        serving path the sharded tile store exists for."""
+        from filodb_tpu.query import tilestore as tst
+
+        be = self.backend
+        if be is None or getattr(be, "mesh_eval", None) is None:
+            return None
+        if not isinstance(plan, lp.PeriodicSeriesWithWindowing):
+            return None
+        if plan.at_ms is not None or plan.func_args:
+            return None
+        if plan.function not in tst.ALIGNED_FUNCS:
+            return None     # gather/order-statistics families stay local
+        raw = plan.raw
+        if not isinstance(raw, lp.RawSeriesPlan):
+            return None
+        shards = self._resolve_shards(plan)
+        if not shards:
+            return None
+        # cross-node leaves dispatch over HTTP, not the local mesh
+        if any(hasattr(s, "fetch_raw") for s in shards):
+            return None
+        hist_kind, _ = self._hist_selection(shards, raw)
+        if hist_kind != "none":
+            return None     # per-series histogram grids stay local
+        return MeshTileExec(plan, shards, self.backend, self.stats,
+                             self.limits)
 
     @staticmethod
     def _hist_selection(shards, raw: lp.RawSeriesPlan):

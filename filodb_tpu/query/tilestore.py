@@ -335,13 +335,15 @@ class AlignedTiles:
         if c is None:
             from filodb_tpu.query.pallas_kernels import (_GS_AL,
                                                          _GS_DSPAN_MAX,
-                                                         _GS_SS, _GS_TT)
+                                                         _GS_SS,
+                                                         _GS_TT_WIDE)
             N = src.shape[0]
             S = src.shape[1]
             # pad the permuted G axis past every tail tile: the kernel's
             # merged kc/kl stream reads up to dspan (<= _GS_DSPAN_MAX)
-            # + alignment rows past the last window-end row
-            G = -(-N // st) + _GS_TT + 2 * _GS_AL + _GS_DSPAN_MAX
+            # + alignment rows past the last window-end row — sized for
+            # the WIDEST step tile the pipeline chooser can pick
+            G = -(-N // st) + _GS_TT_WIDE + 2 * _GS_AL + _GS_DSPAN_MAX
             padn = G * st - N
             if padn:
                 src = jnp.concatenate(
@@ -1225,9 +1227,6 @@ def groupsum_counters(tiles: AlignedTiles, func: str, steps: np.ndarray,
         return None              # the merged block reads one lead row
     S = len(tiles.keys)
     G = int(np.asarray(onehot).shape[1])
-    T_pad = -(-nsteps // pk._GS_TT) * pk._GS_TT
-    if T_pad * G * 8 > 4 << 20:
-        return None              # [T, G] accumulators must fit VMEM
     vch = "cv" if func in ("rate", "increase") else "v"
     if tiles._fixed_channels(vch) is None:
         return None              # non-finite values: exact f64 fallback
@@ -1242,19 +1241,14 @@ def groupsum_counters(tiles: AlignedTiles, func: str, steps: np.ndarray,
                pk.GS_ALT if phase_e < -J else pk.GS_BOTH)
     lo_mode = (pk.GS_CUR if phase_s >= J else
                pk.GS_ALT if phase_s < -J else pk.GS_BOTH)
-    # full VMEM budget, not just the accumulators: the double-buffered
-    # DMA scratch (2 x nstreams x mlen x 3*SS i32) and the onehot/base
-    # input blocks also live in VMEM; an oversized query must fall back
+    # full VMEM budget, not just the accumulators: the pipeline chooser
+    # (pk._gs_pipeline) walks the (step-tile width, DMA pipeline depth)
+    # frontier — accumulators + nbuf x nstreams x mlen scratch + the
+    # onehot/base input blocks — and an oversized query must fall back
     # to the general path HERE, not explode at Mosaic compile time
-    nstreams = 1 + (1 if hi_mode != pk.GS_CUR and st != 1 else 0) \
-        + (1 if lo_mode != pk.GS_CUR and st != 1 else 0)
-    mlen = pk._gs_mlen(st, dspan)
-    vmem_bytes = (2 * T_pad * G * 4                      # sum/cnt accums
-                  + 2 * nstreams * mlen * 3 * pk._GS_SS * 4   # DMA scratch
-                  + pk._GS_SS * G * 4                    # onehot block
-                  + 8 * pk._GS_SS * 4)                   # base block
-    if vmem_bytes > 14 << 20:    # 16MB VMEM core minus compute headroom
-        return None
+    nstreams = pk._gs_nstreams(st, hi_mode, lo_mode)
+    if pk._gs_pipeline(st, dspan, hi_mode, lo_mode, nsteps, G) is None:
+        return None              # no admissible (tt, nbuf) within VMEM
     S_pad = -(-S // pk._GS_SS) * pk._GS_SS
     v_p = tiles.t_perm_fixed_tiled(vch, st)
     base = tiles.t_fixed_base(vch)

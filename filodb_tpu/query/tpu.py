@@ -564,8 +564,15 @@ class TpuBackend:
     the single-query kernel paths."""
 
     def __init__(self, device: Optional[object] = None,
-                 batcher: Optional[object] = "default"):
+                 batcher: Optional[object] = "default",
+                 mesh_eval: Optional[object] = None):
         self.device = device
+        # multi-chip serving (parallel/shardstore.ShardedTileEvaluator):
+        # when set, eligible aligned-tile dispatches run the SAME
+        # evaluator bodies sharded over the ('shard','time') mesh from
+        # device-resident tiles — bit-for-bit the single-device values
+        self.mesh_eval = mesh_eval
+        self.mesh_dispatches = 0    # observability: sharded dispatches
         self._tile_cache: Dict = {}
         # guards cache get/insert/evict against concurrent HTTP query
         # threads (non-atomic FIFO evict could KeyError, inserts overshoot)
@@ -911,6 +918,13 @@ class TpuBackend:
             def refresh():
                 try:
                     fresh = self._build_tile_entry(held, use_snap)
+                    me = self.mesh_eval
+                    if me is not None and stale.tiles is not None:
+                        # cross-flush hand-over of the mesh placement:
+                        # the donated append reuses the resident HBM
+                        # buffers in place (zero-copy) when the new
+                        # tiles extend the old cohort
+                        me.refresh(stale.tiles, fresh.tiles)
                     self._insert_tile_entry(key, ident, fresh)
                 finally:
                     with self._tile_lock:
@@ -1000,29 +1014,44 @@ class TpuBackend:
         counters = func in ("rate", "increase", "delta")
         b = self.batcher
         nsteps = steps.size
+        if counters and nsteps >= 1:
+            family = tst.counters_batch_family(tiles, func, steps,
+                                               window_ms, offset_ms)
+        else:
+            family = None
+        mesh_st = None
+        if not func_args and nsteps >= 1:
+            mesh_st = self._mesh_sharded(tiles, func, steps, window_ms,
+                                         offset_ms, family)
         if b is not None and b.enabled and not func_args and nsteps >= 1:
             w0e = int(steps[0] - offset_ms)
             w0s = w0e - window_ms
             step = int(steps[1] - steps[0]) if nsteps > 1 else 1
-            if counters:
-                family = tst.counters_batch_family(tiles, func, steps,
-                                                   window_ms, offset_ms)
-            else:
-                family = None
             # id(tiles) is safe as a key component: members hold a
             # reference to the tiles object, so the id cannot be
             # recycled while the batch is open
             key = ("aligned", id(tiles), func, nsteps, step, window_ms,
-                   family)
+                   family, mesh_st is not None)
             return b.submit(
                 key, (w0s, w0e, steps, tiles),
                 functools.partial(self._aligned_run, tiles, func,
                                   family, nsteps, step, window_ms,
-                                  offset_ms))
+                                  offset_ms, mesh_st),
+                # ONE thread owns sharded submissions: a mesh program
+                # already spans every device, so inline execution on N
+                # query threads would only oversubscribe it
+                use_executor=True if mesh_st is not None else None)
         with obs_metrics.timed("filodb_device_execute_seconds",
                                _DEV_HELP), \
-                obs_trace.span("device-dispatch", path="aligned"):
+                obs_trace.span("device-dispatch",
+                               path="mesh-aligned" if mesh_st is not None
+                               else "aligned"):
             if counters:
+                if mesh_st is not None:
+                    self.mesh_dispatches += 1
+                    # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+                    return np.asarray(mesh_st.eval_counters(
+                        func, steps, window_ms, offset_ms)).T
                 # counter family rides the slot-major f32-hybrid fast
                 # path: int32 timestamps + exact f64 boundary deltas,
                 # f32 extrapolation epilogue (~3e-7 relative vs the f64
@@ -1032,50 +1061,101 @@ class TpuBackend:
                 # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
                 return np.asarray(tst.evaluate_counters_t(
                     tiles, func, steps, window_ms, offset_ms).T)
+            if mesh_st is not None:
+                self.mesh_dispatches += 1
+                # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
+                return np.asarray(mesh_st.eval_aligned(
+                    tiles, func, steps, window_ms, offset_ms))
             # graftlint: disable=host-transfer-in-hot-loop (single-query path: designed sync point at kernel egress)
             return np.asarray(tst.evaluate_aligned(
                 tiles, func, steps, window_ms, offset_ms, func_args))
 
+    def _mesh_sharded(self, tiles, func: str, steps, window_ms: int,
+                      offset_ms: int, family):
+        """The device-resident sharded placement serving this dispatch,
+        or None for the single-device path. Counter families route only
+        when the single-device dispatcher would pick the f32-hybrid
+        slide/fast evaluator (identical values), so mesh-on vs mesh-off
+        responses stay byte-identical; the exact-f64 wide-grid family
+        keeps the single-device path."""
+        me = self.mesh_eval
+        if me is None or tiles is None:
+            return None
+        if family is not None and family[0] not in ("slide", "fast"):
+            return None
+        st = me.place(tiles)
+        if st is None:
+            return None
+        if family is not None and not st.query_fits(
+                np.asarray(steps), window_ms, offset_ms):
+            return None
+        return st
+
     def _aligned_run(self, tiles, func: str, family, nsteps: int,
                      step: int, window_ms: int, offset_ms: int,
-                     members) -> object:
+                     mesh_st, members) -> object:
         """Execute one aligned batch: B=1 takes the scalar evaluator,
-        B>=2 one vmapped dispatch computing every member's grid."""
+        B>=2 one vmapped dispatch computing every member's grid (the
+        mesh-sharded twins of both when ``mesh_st`` serves)."""
         from filodb_tpu.query import tilestore as tst
         from filodb_tpu.query.batcher import SplitResult
 
         with obs_metrics.timed("filodb_device_execute_seconds",
                                _DEV_HELP), \
-                obs_trace.span("device-dispatch", path="aligned",
+                obs_trace.span("device-dispatch",
+                               path="mesh-aligned" if mesh_st is not None
+                               else "aligned",
                                batch=len(members)):
             return self._aligned_run_inner(tst, SplitResult, tiles,
                                            func, family, nsteps, step,
-                                           window_ms, offset_ms, members)
+                                           window_ms, offset_ms, mesh_st,
+                                           members)
 
     def _aligned_run_inner(self, tst, SplitResult, tiles, func: str,
                            family, nsteps: int, step: int,
-                           window_ms: int, offset_ms: int,
+                           window_ms: int, offset_ms: int, mesh_st,
                            members) -> object:
         counters = func in ("rate", "increase", "delta")
+        if mesh_st is not None:
+            self.mesh_dispatches += len(members)
         if len(members) == 1:
             steps0 = members[0][2]
             if counters:
-                dev = tst.evaluate_counters_t(tiles, func, steps0,
-                                              window_ms, offset_ms)
+                if mesh_st is not None:
+                    dev = mesh_st.eval_counters(func, steps0, window_ms,
+                                                offset_ms)
+                else:
+                    dev = tst.evaluate_counters_t(tiles, func, steps0,
+                                                  window_ms, offset_ms)
                 return SplitResult(dev, 1, split=lambda h, i: h.T)
-            dev = tst.evaluate_aligned(tiles, func, steps0, window_ms,
-                                       offset_ms, ())
+            if mesh_st is not None:
+                dev = mesh_st.eval_aligned(tiles, func, steps0,
+                                           window_ms, offset_ms)
+            else:
+                dev = tst.evaluate_aligned(tiles, func, steps0, window_ms,
+                                           offset_ms, ())
             return SplitResult(dev, 1, split=lambda h, i: h)
         w0s_list = [m[0] for m in members]
         w0e_list = [m[1] for m in members]
         if counters:
-            dev = tst.evaluate_counters_t_batch(
-                tiles, func, family, nsteps, step, w0s_list, w0e_list)
+            if mesh_st is not None:
+                # the mesh-shaped batch: ONE sharded program computes
+                # every member's grid from the resident tiles
+                dev = mesh_st.eval_counters_batch(func, nsteps, step,
+                                                  w0s_list, w0e_list)
+            else:
+                dev = tst.evaluate_counters_t_batch(
+                    tiles, func, family, nsteps, step, w0s_list,
+                    w0e_list)
             # [B_pad, T, S] -> member i's [S, T]
             return SplitResult(dev, len(members),
                                split=lambda h, i: h[i].T)
-        dev = tst.evaluate_aligned_batch(
-            tiles, func, nsteps, step, w0s_list, w0e_list)
+        if mesh_st is not None:
+            dev = mesh_st.eval_aligned_batch(tiles, func, nsteps, step,
+                                             w0s_list, w0e_list)
+        else:
+            dev = tst.evaluate_aligned_batch(
+                tiles, func, nsteps, step, w0s_list, w0e_list)
         return SplitResult(dev, len(members), split=lambda h, i: h[i])
 
     def fused_groupsum(self, series, func: str, steps: np.ndarray,
@@ -1095,11 +1175,14 @@ class TpuBackend:
             return None
         import jax
         on_cpu = jax.default_backend() == "cpu"
-        if on_cpu and not FUSED_GROUPSUM_INTERPRET:
+        if on_cpu and not FUSED_GROUPSUM_INTERPRET \
+                and self.mesh_eval is None:
             # interpret-mode Pallas re-traces per tile shape — with live
             # ingest growing the tiles that is seconds per query; CPU
             # nodes take the vectorized-numpy path instead (tests flip
-            # the flag to exercise the kernel in interpret mode)
+            # the flag to exercise the kernel in interpret mode; the
+            # mesh-sharded grouped collective below is XLA, not Pallas,
+            # so it serves on any backend)
             return None
         entry = self._tile_entry(series)
         tiles, idx = entry.tiles, entry.idx
@@ -1117,9 +1200,25 @@ class TpuBackend:
             if cl < s.ts.size and steps.size and \
                     int(steps[-1] - offset_ms) >= int(s.ts[cl]):
                 return None
+        gvec = np.asarray(gids)[np.asarray(idx)]
+        # mesh-resident grouped collective first: the one-hot matmul +
+        # psum runs off the device-resident sharded tiles (no per-query
+        # pack), honoring the same fast-family eligibility as the
+        # per-series sharded path
+        if self.mesh_eval is not None and steps.size >= 1:
+            mesh_st = self._mesh_sharded(
+                tiles, func, steps, window_ms, offset_ms,
+                tst.counters_batch_family(tiles, func, steps, window_ms,
+                                          offset_ms))
+            if mesh_st is not None:
+                self.fused_aggs += 1
+                self.mesh_dispatches += 1
+                return mesh_st.eval_grouped_pair(func, steps, window_ms,
+                                                 gvec, G, offset_ms)
+        if on_cpu and not FUSED_GROUPSUM_INTERPRET:
+            return None
         onehot = np.zeros((len(series), G), np.float32)
-        onehot[np.arange(len(series)), np.asarray(gids)[np.asarray(idx)]] \
-            = 1.0
+        onehot[np.arange(len(series)), gvec] = 1.0
         res = tst.groupsum_counters(
             tiles, func, steps, window_ms, onehot, offset_ms,
             interpret=on_cpu)

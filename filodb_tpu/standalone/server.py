@@ -39,6 +39,10 @@ DEFAULTS = {
     "default-spread": 1,
     # lower agg(rangefunc(...)) onto the device mesh when >1 jax device
     "mesh-enabled": False,
+    # with mesh-enabled: serve eligible aligned-tile cohorts from
+    # device-RESIDENT sharded tiles (shard_map slot-major evaluators,
+    # donated zero-copy refreshes) instead of single-device dispatch
+    "mesh-tile-serving": True,
     # chunk/partkey/checkpoint persistence root; None = memory-only
     # (conf/timeseries-filodb-server.conf store path equivalent)
     "data-dir": None,
@@ -517,6 +521,19 @@ class FiloServer:
                     mesh_ex = MeshExecutor(make_mesh())
             except Exception:
                 mesh_ex = None
+        if mesh_ex is not None and self.backend is not None \
+                and self.config.get("mesh-tile-serving", True):
+            # multi-chip serving path: eligible aligned-tile cohorts
+            # live sharded across the mesh and the slot-major
+            # evaluators dispatch from the resident tiles (zero-copy
+            # donated refreshes across flushes) — parallel/shardstore
+            try:
+                from filodb_tpu.parallel.shardstore import \
+                    ShardedTileEvaluator
+                self.backend.mesh_eval = ShardedTileEvaluator(
+                    mesh_ex.mesh)
+            except Exception:
+                self.backend.mesh_eval = None
         ds_stores: Dict[str, object] = {}
         retention_ms = 0
         if (self.config.get("raw-retention-s")

@@ -1267,6 +1267,56 @@ def test_donation_safety(tmp_path):
     assert not res.findings and res.suppressed == 1
 
 
+DONATE_TUPLE_CLEAN = """
+import functools
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def append(ts, vals, new):
+    return ts + new, vals + new
+
+
+class Store:
+    def __init__(self):
+        self.ts = None
+        self.vals = None
+
+    def refresh(self, new):
+        # the MULTI-BUFFER zero-copy refresh idiom: every donated
+        # attribute rebound from the result in the same statement
+        self.ts, self.vals = append(self.ts, self.vals, new)
+"""
+
+DONATE_TUPLE_VIOLATION = """
+import functools
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def append(ts, vals, new):
+    return ts + new, vals + new
+
+
+class Store:
+    def __init__(self):
+        self.ts = None
+        self.vals = None
+
+    def refresh(self, new):
+        # self.vals is donated but NOT rebound: live state aliases a
+        # freed buffer
+        self.ts, _scratch = append(self.ts, self.vals, new)
+"""
+
+
+def test_donation_tuple_target_refresh_idiom(tmp_path):
+    assert not lint_src(tmp_path, DONATE_TUPLE_CLEAN).findings
+    res = lint_src(tmp_path, DONATE_TUPLE_VIOLATION)
+    assert rules_of(res) == ["donation-safety"]
+    assert "self.vals" in res.findings[0].message
+
+
 DONATE_MISSING = """
 import jax
 
@@ -1360,6 +1410,62 @@ def test_partition_spec_consistency(tmp_path):
     assert rules_of(lint_src(tmp_path, SPEC_OUT_ARITY)) \
         == ["partition-spec-consistency"]
     assert not lint_src(tmp_path, SPEC_CLEAN).findings
+
+
+SPEC_POSITIONAL_CLEAN = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P(None, 0), P(0)),
+                   out_specs=P(1, 0))
+def f(x, g):
+    return x
+"""
+
+SPEC_POSITIONAL_OUT_OF_RANGE = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P(2, None),), out_specs=P())
+def f(x):
+    return x
+"""
+
+SPEC_POSITIONAL_DOUBLE_NEG = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard", "time"))
+
+@functools.partial(jax.shard_map, mesh=mesh,
+                   in_specs=(P(-1, -1),), out_specs=P())
+def f(x):
+    return x
+"""
+
+
+def test_partition_spec_positional_indices(tmp_path):
+    """Positional PartitionSpec indices (the mesh-agnostic library
+    convention of the sharded tile store) resolve against the mesh
+    axis order: in-range indices are clean, out-of-range and a
+    repeated -1 are findings — the same errors the runtime resolver
+    raises, caught at lint time."""
+    assert not lint_src(tmp_path, SPEC_POSITIONAL_CLEAN).findings
+    res = lint_src(tmp_path, SPEC_POSITIONAL_OUT_OF_RANGE)
+    assert rules_of(res) == ["partition-spec-consistency"]
+    assert "out of range" in res.findings[0].message
+    res = lint_src(tmp_path, SPEC_POSITIONAL_DOUBLE_NEG)
+    assert rules_of(res) == ["partition-spec-consistency"]
+    assert "-1" in res.findings[0].message
 
 
 # -- graftlint v3: cache-invalidation completeness ---------------------------

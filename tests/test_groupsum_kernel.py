@@ -155,3 +155,86 @@ def test_groupsum_dispatcher_fallbacks():
                       dtype=np.int64)
     assert tst.groupsum_counters(bad, "rate", steps, 300_000,
                                  onehot, interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# tile widening + DMA pipeline depth (PR 14: the deferred counter_groupsum
+# DMA pipelining / tile widening)
+# ---------------------------------------------------------------------------
+
+def test_gs_pipeline_chooser_frontier():
+    from filodb_tpu.query import pallas_kernels as pk
+
+    # long range, single stream: the widened 512-step tile + the
+    # triple-buffered DMA pipeline both fit
+    tt, nbuf = pk._gs_pipeline(6, 5, pk.GS_CUR, pk.GS_CUR, 460, 16)
+    assert tt == pk._GS_TT_WIDE and nbuf == pk._GS_NBUF_MAX
+    # three streams: widening would blow the scratch budget — fall to
+    # the 256 tile, and the deepest pipeline that still fits
+    tt3, nbuf3 = pk._gs_pipeline(6, 5, pk.GS_BOTH, pk.GS_BOTH, 460, 16)
+    assert tt3 == pk._GS_TT and nbuf3 >= 2
+    # short ranges never widen (nothing to amortize)
+    tt1, _ = pk._gs_pipeline(6, 5, pk.GS_CUR, pk.GS_CUR, 100, 16)
+    assert tt1 == pk._GS_TT
+    # an impossible footprint yields None (dispatcher falls back): a
+    # giant group count makes even the smallest config exceed VMEM
+    assert pk._gs_pipeline(6, 5, pk.GS_BOTH, pk.GS_BOTH, 30_000,
+                           4096) is None
+
+
+@pytest.mark.parametrize("nsteps", [300, 520])
+def test_groupsum_wide_tile_parity(nsteps):
+    """Step grids past 256 ride the widened 512-step tile (and the
+    deeper DMA pipeline where it fits): parity vs the per-series
+    evaluator must hold through the new tiling."""
+    from filodb_tpu.query import pallas_kernels as pk
+
+    S, G = 64, 4
+    # enough slots that the wide grid stays interior
+    tiles = _tiles(S, N=max(512, nsteps * 6 // 1 + 96), huge=False)
+    steps = (BASE + 400_000
+             + np.arange(nsteps, dtype=np.int64) * 60_000)
+    gid = np.arange(S) % G
+    onehot = np.zeros((S, G), np.float32)
+    onehot[np.arange(S), gid] = 1.0
+    assert pk._gs_pipeline(6, 5, pk.GS_BOTH, pk.GS_BOTH, nsteps,
+                           G) is not None
+    res = tst.groupsum_counters(tiles, "rate", steps, 300_000, onehot,
+                                interpret=True)
+    assert res is not None
+    sums, cnts = np.asarray(res[0]), np.asarray(res[1])
+    assert sums.shape == (nsteps, G)
+    want_s, want_c = _want(tiles, "rate", steps, 300_000, gid, G)
+    np.testing.assert_array_equal(cnts, want_c)
+    np.testing.assert_allclose(sums, want_s, rtol=1e-5, atol=1e-7)
+
+
+def test_groupsum_widest_config_parity_interpret():
+    """The (512-step tile, triple-buffered) config — reachable only in
+    the phase-elided single-stream case — must run the full DMA
+    pipeline correctly (interpret mode emulates the async copies)."""
+    from filodb_tpu.query import pallas_kernels as pk
+
+    S, N, G = 48, 2200, 4
+    # ZERO jitter + on-slot grid phase: both fallback families elide
+    # (GS_CUR/GS_CUR), leaving the single merged stream
+    ts = (BASE + np.arange(N)[None, :] * DT) * np.ones((S, 1))
+    vals = np.cumsum(np.random.default_rng(2).uniform(0, 5, (S, N)),
+                     axis=1)
+    tiles = tst.AlignedTiles([{} for _ in range(S)], BASE, DT,
+                             np.ones((S, N), bool), ts, vals)
+    assert tiles.jitter_ms() == 0.0
+    T = 300
+    steps = BASE + 400_000 + np.arange(T, dtype=np.int64) * 60_000
+    assert pk._gs_pipeline(6, 5, pk.GS_CUR, pk.GS_CUR, T, G) \
+        == (pk._GS_TT_WIDE, pk._GS_NBUF_MAX)
+    gid = np.arange(S) % G
+    onehot = np.zeros((S, G), np.float32)
+    onehot[np.arange(S), gid] = 1.0
+    res = tst.groupsum_counters(tiles, "rate", steps, 300_000, onehot,
+                                interpret=True)
+    assert res is not None
+    sums, cnts = np.asarray(res[0]), np.asarray(res[1])
+    want_s, want_c = _want(tiles, "rate", steps, 300_000, gid, G)
+    np.testing.assert_array_equal(cnts, want_c)
+    np.testing.assert_allclose(sums, want_s, rtol=1e-5, atol=1e-7)

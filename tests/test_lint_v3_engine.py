@@ -112,7 +112,75 @@ def test_registered_cache_inventory_names(df):
     names = {r.name for r in regs}
     assert {"plan", "results", "device-tile", "packed-executable",
             "partition-decode", "partition-merge", "mesh-executable",
-            "tilestore-executables"} <= names
+            "tilestore-executables", "shardstore-executables",
+            "sharded-tile-placement"} <= names
+
+
+# -- the multi-chip serving wiring (PR 14): non-vacuous family pins ----------
+#
+# graftlint's donation-safety / donation-missing /
+# partition-spec-consistency families were error-severity with nothing
+# in-tree to police. These assertions pin that the NEW production sites
+# — the donated tile-refresh jit and the sharded-evaluator shard_map
+# lowerings — are DISCOVERED by the engine on the real modules, so the
+# families can never go silently vacuous again.
+
+SHARDSTORE = "filodb_tpu/parallel/shardstore.py"
+
+
+def test_shardstore_donate_site_discovered(df):
+    flow, _ = df
+    sites = [s for s in flow.sites if s.relpath == SHARDSTORE
+             and s.kind == "jit" and s.donate_nums]
+    assert sites, "the donated tile-refresh jit site is gone"
+    assert any(s.donate_nums == (0, 1, 2) for s in sites), \
+        [s.donate_nums for s in sites]
+    # it wraps _append_step (decorator form -> body key resolved)
+    assert any("_append_step" in bk for s in sites for bk in s.body_keys)
+
+
+def test_shardstore_shard_map_sites_discovered_with_positional_axes(df):
+    flow, _ = df
+    sites = [s for s in flow.sites if s.relpath == SHARDSTORE
+             and s.kind == "shard_map"]
+    # counter single+batch, grouped, grouped-pair lowerings at least
+    assert len(sites) >= 4, [s.line for s in sites]
+    for s in sites:
+        # positional PartitionSpec indices resolve against the module's
+        # ('shard', 'time') mesh order
+        assert flow.site_axes(s) <= {"shard", "time"}, \
+            (s.line, flow.site_axes(s))
+    assert any(sp.pos_entries for s in sites for sp in s.all_specs), \
+        "positional spec entries no longer parsed"
+
+
+def test_shardstore_families_clean_and_nonvacuous(df):
+    """The real modules sweep clean — and the SAME engine flags a
+    mutated twin of the refresh idiom, so 'clean' is a checked verdict,
+    not an unimplemented one."""
+    import ast
+
+    flow, mods = df
+    spmd = [f for _, f in rules_spmd.check_project(mods, df=flow)
+            if f.path == SHARDSTORE]
+    assert not spmd, [f"{f.rule}:{f.line}" for f in spmd]
+    # mutate: drop the same-statement rebind from the donated call —
+    # the donate-of-live-state finding MUST appear
+    path = os.path.join(package_root(), SHARDSTORE)
+    with open(path) as f:
+        src = f.read()
+    mutated = src.replace(
+        "        self._tsr, self._v, self._cv = _append_step(",
+        "        _ignored = _append_step(")
+    assert mutated != src
+    from filodb_tpu.lint import ModuleSource, _parse_pragmas
+    lines = mutated.splitlines()
+    mod = ModuleSource(path=path, relpath=SHARDSTORE, source=mutated,
+                       tree=ast.parse(mutated), lines=lines,
+                       pragmas=_parse_pragmas(lines))
+    finds = [f for _, f in rules_spmd.check_project([mod])
+             if f.rule == "donation-safety"]
+    assert finds, "donation-safety missed the un-rebound refresh twin"
 
 
 # -- CI wiring: the v3 families flow through --json/--github/--changed-only

@@ -1,0 +1,245 @@
+"""Multi-chip serving correctness (the tentpole's acceptance pins).
+
+Mesh-vs-single byte identity: with the device-resident sharded tile
+path on (mesh-enabled server), /api/v1/query_range responses carry a
+byte-identical DATA section for the tilestore-served shapes — the
+sharded evaluator computes the same element values bit-for-bit.
+Grouped / topk / histogram shapes are checked against the CPU oracle
+at 1/2/4/8 virtual devices.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from filodb_tpu.core.memstore import TimeSeriesMemStore
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.gateway.producer import (TestTimeseriesProducer,
+                                         ingest_builders)
+from filodb_tpu.parallel.mesh import MeshExecutor, make_mesh
+from filodb_tpu.parallel.shardmapper import ShardMapper, assign_shards_evenly
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.query.planner import (LocalEngineExec, MeshAggregateExec,
+                                      MeshTileExec, QueryPlanner)
+from filodb_tpu.standalone.server import FiloServer
+
+REF = DatasetRef("timeseries")
+T0 = 1_600_000_000
+
+
+def _plan(q, start=T0 + 600, end=T0 + 3000, step=60):
+    return parse_query_range(q, TimeStepParams(start, step, end))
+
+
+# ---------------------------------------------------------------------------
+# e2e: byte-identical data sections, mesh on vs off
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pair():
+    """Two servers over identically-seeded stores: mesh-tile serving on
+    vs plain single-device."""
+    srvs = []
+    for mesh in (False, True):
+        srv = FiloServer({"num-shards": 2, "grpc-port": None, "port": 0,
+                          "mesh-enabled": mesh,
+                          "results-cache-mb": 0}).start()
+        srv.seed_dev_data(n_samples=60, n_instances=3, start_ms=T0 * 1000)
+        srvs.append(srv)
+    yield srvs
+    for s in srvs:
+        s.stop()
+
+
+def _get(port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{port}{path}?{qs}"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+def _data(raw: bytes) -> str:
+    return json.dumps(json.loads(raw)["data"], sort_keys=True)
+
+
+QUERIES = [
+    "rate(http_requests_total[5m])",
+    "increase(http_requests_total[5m])",
+    "delta(heap_usage[5m])",
+    "sum_over_time(heap_usage[5m])",
+    "avg_over_time(heap_usage[2m])",
+    "sum(rate(http_requests_total[5m])) by (instance)",
+    "avg(rate(http_requests_total[5m])) by (instance)",
+    "count(rate(http_requests_total[5m]))",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_mesh_on_off_data_byte_identity(pair, q):
+    plain, meshed = pair
+    assert meshed.backend.mesh_eval is not None
+    params = dict(query=q, start=T0 + 300, end=T0 + 500, step=60)
+    a = _get(plain.port, "/promql/timeseries/api/v1/query_range",
+             **params)
+    b = _get(meshed.port, "/promql/timeseries/api/v1/query_range",
+             **params)
+    assert _data(a) == _data(b), q
+
+
+def test_mesh_instant_query_matches(pair):
+    """Instant queries ride the mesh too (the tilestore instant shape).
+    XLA lowers the f32 division chain of the epilogue slightly
+    differently between the plain jit and the shard_map program (the
+    sharded result is the correctly-rounded one), so instant values are
+    pinned to f32-ulp tolerance rather than bytes — the range-query
+    byte-identity above is the acceptance pin."""
+    plain, meshed = pair
+    params = dict(query="rate(http_requests_total[5m])", time=T0 + 400)
+    a = json.loads(_get(plain.port, "/promql/timeseries/api/v1/query",
+                        **params))["data"]["result"]
+    b = json.loads(_get(meshed.port, "/promql/timeseries/api/v1/query",
+                        **params))["data"]["result"]
+    assert len(a) == len(b) > 0
+    for ra, rb in zip(a, b):
+        assert ra["metric"] == rb["metric"]
+        va, vb = float(ra["value"][1]), float(rb["value"][1])
+        assert va == pytest.approx(vb, rel=1e-5)
+
+
+def test_mesh_dispatches_actually_happened(pair):
+    _plain, meshed = pair
+    assert meshed.backend.mesh_dispatches >= 1
+    snap = meshed.backend.mesh_eval.snapshot()
+    assert snap["placements"] >= 1 and snap["devices"] == 8
+
+
+def test_mesh_executables_attributed_per_device_count(pair):
+    """devprof attribution: the sharded executables show up under the
+    'mesh-tiles' site with the mesh shape in their keys, with XLA
+    cost_analysis captured by the AOT build path."""
+    from filodb_tpu.obs import devprof
+    entries = [e for e in devprof.GLOBAL_PROFILER.snapshot()
+               if e["site"] == "mesh-tiles"]
+    assert entries, "no mesh-tiles executables profiled"
+    assert any("flops" in e or "bytes_accessed" in e for e in entries)
+    # the device count rides the key (the _mesh_key tuple tail)
+    assert any("8" in e["executable"] for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# plan lowering
+# ---------------------------------------------------------------------------
+
+def test_planner_lowers_tilestore_shapes_to_mesh_tile_exec(pair):
+    _plain, meshed = pair
+    planner = meshed.http.make_planner("timeseries")
+    assert isinstance(planner.materialize(
+        _plan("rate(http_requests_total[5m])")), MeshTileExec)
+    assert isinstance(planner.materialize(
+        _plan("sum_over_time(heap_usage[5m])")), MeshTileExec)
+    # fused grouped shape rides the resident path too
+    assert isinstance(planner.materialize(
+        _plan("sum(rate(http_requests_total[5m])) by (instance)")),
+        MeshTileExec)
+    # min/max keep the scatter-gather collective, order statistics stay
+    # local
+    assert isinstance(planner.materialize(
+        _plan("max(rate(http_requests_total[5m]))")), MeshAggregateExec)
+    assert isinstance(planner.materialize(
+        _plan("quantile_over_time(0.9, heap_usage[5m])")),
+        LocalEngineExec)
+
+
+def test_planner_without_mesh_eval_keeps_local(pair):
+    plain, _meshed = pair
+    planner = plain.http.make_planner("timeseries")
+    assert isinstance(planner.materialize(
+        _plan("rate(http_requests_total[5m])")), LocalEngineExec)
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather shapes vs the CPU oracle at 1/2/4/8 devices
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    store = TimeSeriesMemStore(DEFAULT_SCHEMAS)
+    for sh in range(8):
+        store.setup(REF, sh)
+    producer = TestTimeseriesProducer(DEFAULT_SCHEMAS, num_shards=8,
+                                      spread=1)
+    ingest_builders(store, REF, producer.counters(T0 * 1000, 360, 6))
+    ingest_builders(store, REF, producer.gauges(T0 * 1000, 360, 6))
+    store.flush_all(REF)
+    mapper = ShardMapper(8)
+    assign_shards_evenly(mapper, ["node0"])
+    for s in range(8):
+        mapper.activate(s)
+    return store, mapper
+
+
+@pytest.mark.parametrize("ndev,tp", [(1, 1), (2, 1), (4, 2), (8, 2)])
+@pytest.mark.parametrize("q", [
+    "topk(2, rate(http_requests_total[5m]))",
+    "sum(rate(http_requests_total[5m])) by (instance)",
+    "min(sum_over_time(heap_usage[2m])) by (instance)",
+    "sum(rate(request_latency[5m])) by (instance)",     # histogram
+])
+def test_mesh_aggregate_matches_oracle_across_device_counts(
+        cluster, ndev, tp, q):
+    store, mapper = cluster
+    shards = store.shards(REF)
+    mesh = make_mesh(n_shard_groups=ndev // tp, time_parallel=tp,
+                     devices=jax.devices()[:ndev])
+    planner = QueryPlanner(shards, shard_mapper=mapper,
+                           mesh_executor=MeshExecutor(mesh), spread=1)
+    mat = planner.materialize(_plan(q))
+    assert isinstance(mat, MeshAggregateExec), q
+    got = mat.execute()
+    want = QueryEngine(shards).execute(_plan(q))
+    gmap = {tuple(sorted(k.items())): i for i, k in enumerate(got.keys)}
+    assert len(gmap) == want.num_series
+    for i, k in enumerate(want.keys):
+        j = gmap[tuple(sorted(k.items()))]
+        if want.is_hist():
+            np.testing.assert_allclose(
+                got.hist_values[j], want.hist_values[i], rtol=1e-8,
+                equal_nan=True, err_msg=q)
+        else:
+            np.testing.assert_allclose(got.values[j], want.values[i],
+                                       rtol=1e-8, equal_nan=True,
+                                       err_msg=q)
+
+
+# ---------------------------------------------------------------------------
+# cross-flush donated refresh, end to end
+# ---------------------------------------------------------------------------
+
+def test_mesh_results_track_ingest_across_flush(pair):
+    """New samples ingested + flushed after the placement was built
+    must show up in mesh-served responses exactly as in the plain
+    server's (the refresh path re-places or donates — either way, no
+    stale serving)."""
+    plain, meshed = pair
+    for srv in (plain, meshed):
+        srv.seed_dev_data(n_samples=20, n_instances=3,
+                          start_ms=(T0 + 600) * 1000)
+    params = dict(query="rate(http_requests_total[5m])",
+                  start=T0 + 550, end=T0 + 750, step=60, cache="false")
+    deadline = 30
+    import time
+    for _ in range(deadline):
+        a = _get(plain.port, "/promql/timeseries/api/v1/query_range",
+                 **params)
+        b = _get(meshed.port, "/promql/timeseries/api/v1/query_range",
+                 **params)
+        if _data(a) == _data(b):
+            break
+        time.sleep(0.5)
+    assert _data(a) == _data(b)

@@ -145,6 +145,13 @@ def test_tick_is_idempotent_per_series_set():
 
 @pytest.fixture(scope="module")
 def selfmon_server():
+    # isolate the process-global metrics registry: earlier test modules
+    # (test_qos's admission server, any HTTP e2e) leave lazily-created
+    # families — e.g. filodb_query_latency_seconds with a nonzero count
+    # — which the selfmon loop would ingest on its PRE-seed ticks,
+    # making the assertions below depend on which module ran first in
+    # this process. Families re-create lazily; collectors survive.
+    obs_metrics.GLOBAL_REGISTRY.reset()
     srv = FiloServer({
         "num-shards": 2, "port": 0,
         "self-monitor": True,
@@ -198,8 +205,19 @@ def test_selfmon_e2e_promql_over_own_metrics(selfmon_server):
         assert r["metric"]["_ws_"] == SELFMON_TENANT
         ts_last = float(r["values"][-1][0])
         assert now - 60 <= ts_last <= now + 2
-    inf_row = [r for r in res if r["metric"].get("le") == "+Inf"][0]
-    assert float(inf_row["values"][-1][1]) >= 2  # the seeded queries
+    # the +Inf bucket must reflect the 2 seeded queries; the first
+    # non-empty fetch can race a pre-seed tick, so poll the MONOTONE
+    # counter until a post-seed tick lands (bounded)
+    deadline = time.monotonic() + 15
+    while True:
+        inf_row = [r for r in res if r["metric"].get("le") == "+Inf"][0]
+        if float(inf_row["values"][-1][1]) >= 2:
+            break
+        assert time.monotonic() < deadline, \
+            f"+Inf bucket never reached the seeded count: {inf_row}"
+        time.sleep(0.3)
+        res, now = _fresh_series(
+            srv, "filodb_query_latency_seconds_bucket")
 
     # one QoS tenant family, produced by the loop too
     res2, _ = _fresh_series(srv, "filodb_tenant_budget_remaining")
