@@ -46,6 +46,20 @@ Rule families (see the rule modules for the catalog):
     generated well-typed queries engine-vs-reference
     (``promql-differential-mismatch``); ``--changed-only`` skips the
     soak (the full rail runs in tier-1).
+  * ``rules_numerics`` (v4) — numeric-precision & determinism dataflow
+    (``numerics.py`` annotations): provable f64/int64 values narrowing
+    into f32/int32 without a ``@precision(bits=..., reason=...)``
+    budget (``precision-narrowing``), f32 accumulations without a
+    static term bound under the mantissa (``accumulation-bound``),
+    mesh-shape-dependent float reductions without
+    ``@order_insensitive(tolerance=...)``
+    (``reduction-order-determinism``), and f32/f64-mixed or
+    int-cast-to-float comparisons inside Pallas bodies
+    (``mixed-dtype-comparison``). The inversion: ``ulpcert.py``
+    evaluates every annotation on seeded inputs, f64-reference vs
+    production dtype (order claims at 1/2/4/8 virtual devices), and
+    CERTIFIES the claimed tolerance — an uncertifiable annotation is
+    an error (``ulp-certification``).
   * ``rules_cache`` (v3) — the cache inventory (``caches.py``):
     every ``@publishes`` mutation publisher must reach every
     registered cache's invalidation hook (through inferred
@@ -281,8 +295,9 @@ def _load_rule_modules() -> None:
     from filodb_tpu.lint import (rules_cache,  # noqa: F401
                                  rules_concurrency, rules_hot,
                                  rules_kernel, rules_lock,
-                                 rules_promql, rules_span, rules_spmd,
-                                 rules_trace)
+                                 rules_numerics, rules_promql,
+                                 rules_span, rules_spmd, rules_trace,
+                                 ulpcert)
 
 
 def run_lint(paths: Optional[Sequence[str]] = None, *,
@@ -302,11 +317,17 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     ``report_only`` (a set of repo-relative paths) keeps the analysis
     whole-program but drops findings anchored outside those files —
     the ``--changed-only`` pre-commit mode."""
+    # the ulp-certification rail needs 1/2/4/8 virtual devices; the
+    # flag must land before ANY rule initializes the jax backend (the
+    # promql soak and the kernel contracts both do). No-op when a
+    # backend is already up (tests force 8 devices in conftest).
+    from filodb_tpu.lint.ulpcert import ensure_virtual_devices
+    ensure_virtual_devices()
     _load_rule_modules()
     from filodb_tpu.lint import (rules_cache, rules_concurrency,
                                  rules_hot, rules_kernel, rules_lock,
-                                 rules_promql, rules_span, rules_spmd,
-                                 rules_trace)
+                                 rules_numerics, rules_promql,
+                                 rules_span, rules_spmd, rules_trace)
     from filodb_tpu.lint import callgraph as _cgmod
     from filodb_tpu.lint import dataflow as _dfmod
     root = package_root()
@@ -350,6 +371,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         raw.append((bymod_path.get(relpath), f))
     for relpath, f in rules_cache.check_project(mods, cg=cg, df=df):
         raw.append((bymod_path.get(relpath), f))
+    for relpath, f in rules_numerics.check_project(mods, cg=cg, df=df):
+        raw.append((bymod_path.get(relpath), f))
     # promql family: shipped rule-file sweep + (full runs only) the
     # seeded differential micro-soak. --changed-only skips the soak —
     # the fast pre-commit path; tier-1 runs the full rail.
@@ -361,6 +384,17 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         for relpath, f in rules_kernel.check_contracts(mods, root):
             mod = bymod.get(relpath)
             raw.append((mod, f) if mod is not None else (None, f))
+        # the ulp-certification rail (numerics annotations evaluated
+        # f64-reference vs production, order claims at 1/2/4/8 virtual
+        # devices) rides the same runtime-verification gate as the
+        # kernel contracts; skipped under --changed-only (pre-commit
+        # fast path — tier-1 runs the full rail). Results are memoized
+        # per process, so fixture-scoped run_lint calls stay fast.
+        if report_only is None:
+            from filodb_tpu.lint import ulpcert
+            for relpath, f in ulpcert.check_certifications(mods):
+                mod = bymod.get(relpath)
+                raw.append((mod, f) if mod is not None else (None, f))
     for mod, f in raw:
         if mod is not None and _suppressed(mod, f):
             result.suppressed += 1

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
     ap.add_argument("--github", action="store_true", dest="as_github",
                     help="emit GitHub workflow ::error/::warning "
                          "annotation lines (CI inline PR comments)")
+    ap.add_argument("--sarif", action="store_true", dest="as_sarif",
+                    help="emit a SARIF 2.1.0 report on stdout (all "
+                         "rule families in the tool driver) for "
+                         "code-scanning UIs")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the shipped "
                          "filodb_tpu/lint/baseline.json)")
@@ -85,6 +89,11 @@ def main(argv=None) -> int:
             print("graftlint: --changed-only: no changed .py files",
                   file=sys.stderr)
             return 0
+    # the ulp-certification rail needs virtual devices for the
+    # 1/2/4/8-device order-insensitivity runs; ask before any backend
+    # initialization (no-op once a backend is up, as in tests)
+    from filodb_tpu.lint.ulpcert import ensure_virtual_devices
+    ensure_virtual_devices()
     result = run_lint(args.paths or None,
                       baseline=load_baseline(args.baseline),
                       check_contracts=not args.no_contracts,
@@ -93,6 +102,12 @@ def main(argv=None) -> int:
         from filodb_tpu.lint.ci_annotations import github_annotations
         for line in github_annotations(result.to_json()):
             print(line)
+        print(f"graftlint: {result.files} file(s), "
+              f"{len(result.errors)} error(s)", file=sys.stderr)
+    elif args.as_sarif:
+        from filodb_tpu.lint.ci_annotations import sarif_report
+        print(json.dumps(sarif_report(result.to_json()), indent=2,
+                         sort_keys=True))
         print(f"graftlint: {result.files} file(s), "
               f"{len(result.errors)} error(s)", file=sys.stderr)
     elif args.as_json:

@@ -1,4 +1,4 @@
-"""GitHub-workflow annotations from graftlint findings.
+"""GitHub-workflow annotations & SARIF reports from graftlint findings.
 
 Turns a :class:`~filodb_tpu.lint.LintResult` (or its ``--json``
 serialization) into GitHub's workflow-command lines::
@@ -50,3 +50,66 @@ def github_annotations(result_json: Dict) -> List[str]:
     for f in result_json.get("baselined", []):
         out.append(_line("warning", f))
     return out
+
+
+def _sarif_result(f: Dict, level: str) -> Dict:
+    return {
+        "ruleId": f.get("rule", ""),
+        "level": level,
+        "message": {"text": f.get("message", "")},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.get("path", ""),
+                                     "uriBaseId": "%SRCROOT%"},
+                "region": {"startLine": max(int(f.get("line", 1)), 1)},
+            },
+        }],
+        "partialFingerprints": {
+            "graftlint/key": f"{f.get('path', '')}::{f.get('rule', '')}"
+                             f"::{f.get('context', '')}",
+        },
+    }
+
+
+def sarif_report(result_json: Dict) -> Dict:
+    """SARIF 2.1.0 log for one lint run (``LintResult.to_json()``
+    shape) so findings land in code-scanning UIs. The tool driver
+    carries the FULL rule catalog — every graftlint family (kernel,
+    trace, lock, concurrency, spmd, cache, promql, numerics, span,
+    hot-path, meta) — so the UI can group and filter by rule; new
+    findings report at their registered severity, baselined
+    (grandfathered) findings report as ``note`` so they stay visible
+    without failing a gate."""
+    from filodb_tpu.lint import rules
+    catalog = rules()
+    driver_rules = [
+        {
+            "id": rid,
+            "shortDescription": {"text": rule.doc},
+            "properties": {"family": rule.family},
+            "defaultConfiguration": {
+                "level": "error" if rule.severity == "error"
+                else "warning"},
+        }
+        for rid, rule in sorted(catalog.items())
+    ]
+    results: List[Dict] = []
+    for f in result_json.get("findings", []):
+        level = "error" if f.get("severity", "error") == "error" \
+            else "warning"
+        results.append(_sarif_result(f, level))
+    for f in result_json.get("baselined", []):
+        results.append(_sarif_result(f, "note"))
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "https://example.invalid/graftlint",
+                "rules": driver_rules,
+            }},
+            "results": results,
+        }],
+    }

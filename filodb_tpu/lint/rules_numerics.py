@@ -1,0 +1,550 @@
+"""Numeric-precision & determinism dataflow rules (graftlint v4).
+
+Built on :mod:`filodb_tpu.lint.dataflow` (entry points, per-site
+closures) with a local dtype-class inference: every assignment inside a
+traced function is classified into {f64, f32, i64, i32, bool, neutral,
+unknown} from explicit dtypes (``astype``, ``dtype=`` kwargs,
+``jnp.float32(...)`` constructors, dtype aliases like ``f32 =
+jnp.float32``) and propagation through arithmetic (the widest operand
+wins; anything touching an unknown stays unknown — the rules only fire
+on PROVABLE facts, never on inference gaps). Four error families:
+
+  * ``precision-narrowing`` — a value with provable f64/int64
+    provenance flows into an f32/int32 cast inside a traced function
+    that carries no ``@precision(bits=..., reason=...)`` annotation
+    (on itself or a lexical ancestor). The int31 relative-timestamp
+    span-guard idiom is the canonical annotated instance: the
+    narrowing is SAFE, but only because a dispatcher guard proves the
+    span fits — the annotation names that proof.
+  * ``accumulation-bound`` — an f32-accumulated reduction (sum /
+    cumsum / dot / matmul / psum) whose term count is not statically
+    bounded under the f32 mantissa (2**24): the enclosing function
+    must carry ``@precision`` with ``accum_terms=N`` (checked
+    ``N <= 2**24``) or ``compensated=True`` (f64 accumulate /
+    compensated sum), or accumulate in f64 via ``dtype=``. A declared
+    bound exceeding the accumulator mantissa is itself an error.
+  * ``reduction-order-determinism`` — a float (or unprovable-dtype)
+    ``psum``/``pmean``/``psum_scatter``/``segment_sum`` inside a
+    shard_map-traced closure: the reduction grouping depends on mesh
+    shape and device count, so the site must be
+    ``@order_insensitive(tolerance=...)`` (certified across 1/2/4/8
+    virtual devices by the ulpcert rail; ``tolerance=0.0`` claims
+    byte-identity and is certified bitwise — the static cross-check
+    for the mesh-on/off parity pins) or provably integer/exact
+    (integer operand, or pmin/pmax which are order-free).
+  * ``mixed-dtype-comparison`` — inside a Pallas kernel body, a
+    comparison whose operands mix f32 and f64, or whose operand is a
+    float cast of a provably-integer value: the comparison's branch
+    can flip across backends (XLA:TPU rounds int→f32 differently past
+    2**24 than the f64 host path), which is exactly the class of bug
+    no single-backend test catches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from filodb_tpu.lint import Finding, ModuleSource, register_rule
+from filodb_tpu.lint import callgraph as cgmod
+from filodb_tpu.lint import dataflow as dfmod
+from filodb_tpu.lint.rules_spmd import _own_nodes
+
+register_rule("precision-narrowing", "numerics",
+              "f64/int64 value flows into an f32/int32 op without a "
+              "@precision(bits=..., reason=...) annotation")
+register_rule("accumulation-bound", "numerics",
+              "f32 accumulation without a static term bound under the "
+              "mantissa (2**24) or a compensated/f64-accumulate marker")
+register_rule("reduction-order-determinism", "numerics",
+              "mesh-shape-dependent float reduction (psum/segment-sum/"
+              "one-hot matmul) without @order_insensitive(tolerance=...)"
+              " and not provably integer/exact")
+register_rule("mixed-dtype-comparison", "numerics",
+              "f32/f64-mixed or int-cast-to-float comparison inside a "
+              "Pallas body — branch behavior can differ across backends")
+
+# dtype classes
+F64, F32, F16, I64, I32, BOOL, NEUTRAL = \
+    "f64", "f32", "f16", "i64", "i32", "bool", "neutral"
+
+_DTYPE_LEAVES = {
+    "float64": F64, "double": F64,
+    "float32": F32,
+    "float16": F16, "bfloat16": F16,
+    "int64": I64, "uint64": I64,
+    "int32": I32, "uint32": I32, "int8": I32, "uint8": I32,
+    "int16": I32, "uint16": I32,
+    "bool_": BOOL,
+}
+
+_FLOATS = {F64, F32, F16}
+_INTS = {I64, I32}
+_WIDE = {F64, I64}
+_NARROW_FLOAT = {F32, F16}
+
+_MANTISSA = {F32: 24, F16: 11, F64: 53}
+
+# reductions whose accumulator the accumulation-bound family budgets
+_ACCUM_LEAVES = {"sum", "nansum", "cumsum", "dot", "matmul", "einsum",
+                 "psum", "pmean"}
+# order-dependent collectives / segment reductions (pmin/pmax/segment_
+# min/max are order-free and exempt)
+_ORDER_COLLECTIVES = {"psum", "pmean", "psum_scatter", "pdot"}
+_ORDER_SEGMENTS = {"segment_sum", "segment_prod"}
+
+
+def _dtype_class_of_expr(expr, aliases: Dict[str, str]) -> Optional[str]:
+    """Dtype class named by a dtype-position expression
+    (``jnp.float32`` / a local alias / a 'float32' string)."""
+    leaf = dfmod._leaf(expr)
+    if leaf is not None:
+        if leaf in _DTYPE_LEAVES:
+            return _DTYPE_LEAVES[leaf]
+        if leaf in aliases:
+            return aliases[leaf]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _DTYPE_LEAVES.get(expr.value)
+    return None
+
+
+def _widest(classes: Sequence[Optional[str]]) -> Optional[str]:
+    """Widest dtype class of operands; None (unknown) dominates so the
+    rules never fire on an inference gap."""
+    real = [c for c in classes if c != NEUTRAL]
+    if any(c is None for c in real):
+        return None
+    if not real:
+        return NEUTRAL
+    floats = [c for c in real if c in _FLOATS]
+    if floats:
+        for c in (F64, F32, F16):
+            if c in floats:
+                return c
+    ints = [c for c in real if c in _INTS]
+    if ints:
+        return I64 if I64 in ints else I32
+    return real[0]
+
+
+class _DtypeEnv:
+    """Per-function dtype-class environment: two passes over the
+    assignments in source order reach a fixpoint for the straight-line
+    channel math these kernels are made of."""
+
+    def __init__(self, fn_node, aliases: Dict[str, str]):
+        self.aliases = dict(aliases)
+        self.env: Dict[str, Optional[str]] = {}
+        # names holding a float cast of a provably-integer value (the
+        # mixed-dtype-comparison family's taint)
+        self.float_from_int: set = set()
+        # local dtype aliases (f32 = jnp.float32)
+        for node in _own_nodes(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                cls = _dtype_class_of_expr(node.value, self.aliases)
+                if cls is not None and dfmod._leaf(node.value) \
+                        in _DTYPE_LEAVES:
+                    self.aliases[node.targets[0].id] = cls
+        for _ in range(2):
+            for node in _own_nodes(fn_node):
+                if isinstance(node, ast.Assign):
+                    cls = self.classify(node.value)
+                    tainted = self.is_int_float_cast(node.value)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.env[t.id] = cls
+                            if tainted:
+                                self.float_from_int.add(t.id)
+                            else:
+                                self.float_from_int.discard(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            for el in t.elts:
+                                if isinstance(el, ast.Name):
+                                    self.env[el.id] = None
+                elif isinstance(node, ast.AugAssign) \
+                        and isinstance(node.target, ast.Name):
+                    cls = _widest([self.env.get(node.target.id),
+                                   self.classify(node.value)])
+                    self.env[node.target.id] = cls
+
+    # -- classification ------------------------------------------------
+    def classify(self, e) -> Optional[str]:
+        if isinstance(e, ast.Constant):
+            v = e.value
+            if isinstance(v, bool):
+                return BOOL
+            if isinstance(v, int):
+                return NEUTRAL
+            if isinstance(v, float):
+                return NEUTRAL
+            return None
+        if isinstance(e, ast.Name):
+            if e.id in self.env:
+                return self.env[e.id]
+            return None
+        if isinstance(e, ast.UnaryOp):
+            return self.classify(e.operand)
+        if isinstance(e, ast.Compare):
+            return BOOL
+        if isinstance(e, ast.BoolOp):
+            return BOOL
+        if isinstance(e, ast.BinOp):
+            return _widest([self.classify(e.left),
+                            self.classify(e.right)])
+        if isinstance(e, ast.IfExp):
+            return _widest([self.classify(e.body),
+                            self.classify(e.orelse)])
+        if isinstance(e, ast.Subscript):
+            return self.classify(e.value)
+        if isinstance(e, ast.Attribute):
+            if e.attr == "T":
+                return self.classify(e.value)
+            return None
+        if isinstance(e, ast.Call):
+            return self._classify_call(e)
+        return None
+
+    def _classify_call(self, e: ast.Call) -> Optional[str]:
+        leaf = dfmod._leaf(e.func)
+        for kw in e.keywords:
+            if kw.arg == "dtype":
+                cls = _dtype_class_of_expr(kw.value, self.aliases)
+                if cls is not None:
+                    return cls
+        if leaf == "astype" and isinstance(e.func, ast.Attribute):
+            if e.args:
+                return _dtype_class_of_expr(e.args[0], self.aliases)
+            return None
+        if leaf in _DTYPE_LEAVES:
+            return _DTYPE_LEAVES[leaf]
+        if leaf in self.aliases:
+            return self.aliases[leaf]
+        if leaf == "broadcasted_iota" and e.args:
+            return _dtype_class_of_expr(e.args[0], self.aliases)
+        if leaf == "axis_index":
+            return I32
+        if leaf in ("where",):
+            return _widest([self.classify(a) for a in e.args[1:3]])
+        if leaf in ("floor", "ceil", "rint", "abs", "clip", "minimum",
+                    "maximum", "take", "reshape", "transpose", "mod",
+                    "floor_divide", "concatenate", "stack", "pad",
+                    "cumsum", "sum", "nansum", "dot", "matmul",
+                    "dynamic_slice", "dynamic_slice_in_dim",
+                    "dynamic_update_slice_in_dim", "squeeze",
+                    "broadcast_to", "swapaxes", "ldexp"):
+            args = e.args[:1] if leaf in ("take", "clip", "pad") \
+                else e.args
+            return _widest([self.classify(a) for a in args]
+                           or [None])
+        if leaf in ("isnan", "isfinite", "isinf", "logical_and",
+                    "logical_or", "logical_not"):
+            return BOOL
+        if leaf == "arange":
+            # without an explicit dtype the result depends on x64 mode
+            return None
+        return None
+
+    def is_int_float_cast(self, e) -> bool:
+        """``e`` is (or names) a float cast of a provably-int value."""
+        if isinstance(e, ast.Name):
+            return e.id in self.float_from_int
+        if isinstance(e, ast.Call):
+            cast = self.cast_site(e)
+            return cast is not None and cast[0] in _FLOATS \
+                and cast[1] in _INTS
+        return False
+
+    # -- cast-site detection -------------------------------------------
+    def cast_site(self, e: ast.Call
+                  ) -> Optional[Tuple[str, Optional[str], ast.AST]]:
+        """(target class, operand class, operand expr) when ``e`` is a
+        dtype cast — ``x.astype(D)`` or ``D(x)`` — else None."""
+        leaf = dfmod._leaf(e.func)
+        if leaf == "astype" and isinstance(e.func, ast.Attribute) \
+                and e.args:
+            tgt = _dtype_class_of_expr(e.args[0], self.aliases)
+            if tgt is None:
+                return None
+            return tgt, self.classify(e.func.value), e.func.value
+        if leaf in _DTYPE_LEAVES and len(e.args) == 1 \
+                and not e.keywords:
+            # constructor form jnp.int32(x); require a jnp/np/jax base
+            # or a known alias so unrelated calls don't classify
+            if isinstance(e.func, ast.Attribute) or leaf in self.aliases:
+                return (_DTYPE_LEAVES[leaf], self.classify(e.args[0]),
+                        e.args[0])
+        return None
+
+
+# -- annotation discovery ----------------------------------------------------
+
+
+def _int_const(e) -> Optional[int]:
+    """Tiny constant folder for annotation kwargs (2**24, 1 << 20)."""
+    if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+            and not isinstance(e.value, bool):
+        return e.value
+    if isinstance(e, ast.BinOp):
+        l, r = _int_const(e.left), _int_const(e.right)
+        if l is None or r is None:
+            return None
+        try:
+            if isinstance(e.op, ast.Pow):
+                return l ** r
+            if isinstance(e.op, ast.LShift):
+                return l << r
+            if isinstance(e.op, ast.Mult):
+                return l * r
+            if isinstance(e.op, ast.Add):
+                return l + r
+            if isinstance(e.op, ast.Sub):
+                return l - r
+        except (OverflowError, ValueError):
+            return None
+    if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.USub):
+        v = _int_const(e.operand)
+        return -v if v is not None else None
+    return None
+
+
+class _Annotations:
+    """@precision / @order_insensitive decorators per function key,
+    with parsed static kwargs."""
+
+    def __init__(self, cg: cgmod.CallGraph):
+        self.precision: Dict[str, Dict[str, object]] = {}
+        self.order: Set[str] = set()
+        for key, fi in cg.funcs.items():
+            node = fi.node
+            if isinstance(node, ast.Lambda):
+                continue
+            for d in node.decorator_list:
+                call = d if isinstance(d, ast.Call) else None
+                target = call.func if call else d
+                leaf = dfmod._leaf(target)
+                if leaf == "precision":
+                    info: Dict[str, object] = {}
+                    if call:
+                        for kw in call.keywords:
+                            if kw.arg == "accum_terms":
+                                info["accum_terms"] = _int_const(kw.value)
+                            elif kw.arg == "compensated":
+                                info["compensated"] = (
+                                    isinstance(kw.value, ast.Constant)
+                                    and kw.value.value is True)
+                            elif kw.arg == "bits":
+                                info["bits"] = _int_const(kw.value)
+                    self.precision[key] = info
+                elif leaf == "order_insensitive":
+                    self.order.add(key)
+
+    def _ancestors(self, cg: cgmod.CallGraph, key: str) -> List[str]:
+        out = [key]
+        fi = cg.funcs.get(key)
+        if fi is None:
+            return out
+        qual = fi.qualname
+        while ".<locals>." in qual:
+            qual = qual.rsplit(".<locals>.", 1)[0]
+            out.append(f"{fi.module}:{qual}")
+        return out
+
+    def precision_for(self, cg, key: str) -> Optional[Dict[str, object]]:
+        for k in self._ancestors(cg, key):
+            if k in self.precision:
+                return self.precision[k]
+        return None
+
+    def order_for(self, cg, key: str) -> bool:
+        return any(k in self.order for k in self._ancestors(cg, key))
+
+
+# -- the families ------------------------------------------------------------
+
+
+def _module_aliases(mod: ModuleSource) -> Dict[str, str]:
+    """Module-level dtype aliases (``f32 = jnp.float32``)."""
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            leaf = dfmod._leaf(node.value)
+            if leaf in _DTYPE_LEAVES:
+                out[node.targets[0].id] = _DTYPE_LEAVES[leaf]
+    return out
+
+
+def check_project(mods: Sequence[ModuleSource],
+                  cg: Optional[cgmod.CallGraph] = None,
+                  df: Optional[dfmod.DeviceDataflow] = None
+                  ) -> List[Tuple[Optional[str], Finding]]:
+    if df is None:
+        df = dfmod.build(mods, cg)
+    cg = df.cg
+    ann = _Annotations(cg)
+    bymod = {m.relpath: m for m in mods}
+    out: List[Tuple[Optional[str], Finding]] = []
+
+    pallas_keys: Set[str] = set()
+    for site in df.sites:
+        if site.kind == "pallas_call":
+            pallas_keys |= df.closure_of(site.body_keys)
+    # rules_trace's heuristic: a *_ref parameter marks a Pallas kernel
+    # body even before its pallas_call site exists
+    for key, fi in cg.funcs.items():
+        node = fi.node
+        if not isinstance(node, ast.Lambda) and any(
+                a.arg.endswith("_ref") for a in node.args.args):
+            pallas_keys.add(key)
+
+    for key in sorted(df.traced | pallas_keys):
+        fi = cg.funcs.get(key)
+        if fi is None:
+            continue
+        mod = bymod.get(fi.relpath)
+        if mod is None:
+            continue
+        env = _DtypeEnv(fi.node, _module_aliases(mod))
+        p_ann = ann.precision_for(cg, key)
+        o_ann = ann.order_for(cg, key)
+
+        for node in _own_nodes(fi.node):
+            if not isinstance(node, (ast.Call, ast.BinOp, ast.Compare)):
+                continue
+            # (1) precision-narrowing
+            if isinstance(node, ast.Call):
+                cast = env.cast_site(node)
+                if cast is not None:
+                    tgt, src, _operand = cast
+                    if tgt in (F32, F16, I32) and src in _WIDE \
+                            and p_ann is None:
+                        out.append((fi.relpath, Finding(
+                            rule="precision-narrowing", path=fi.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"{fi.qualname}: a {src} value is cast "
+                                f"to {tgt} in a traced function with no "
+                                f"@precision(bits=..., reason=...) "
+                                f"budget — if a guard makes this safe "
+                                f"(span guard, exact split), annotate "
+                                f"the site with it"),
+                            context=f"{fi.qualname}:narrow:{src}->{tgt}")))
+            # (2) accumulation-bound
+            acc = _accum_site(node, env)
+            if acc is not None:
+                acc_cls, label = acc
+                if acc_cls in _NARROW_FLOAT:
+                    terms = (p_ann or {}).get("accum_terms")
+                    comp = bool((p_ann or {}).get("compensated"))
+                    limit = 2 ** _MANTISSA[acc_cls]
+                    if p_ann is None or (terms is None and not comp):
+                        out.append((fi.relpath, Finding(
+                            rule="accumulation-bound", path=fi.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"{fi.qualname}: {label} accumulates in "
+                                f"{acc_cls} with no static term bound — "
+                                f"declare @precision(accum_terms=N) "
+                                f"(N <= 2**{_MANTISSA[acc_cls]}) or "
+                                f"compensated=True, or accumulate in "
+                                f"f64 via dtype="),
+                            context=f"{fi.qualname}:accum:{label}")))
+                    elif terms is not None and terms > limit:
+                        out.append((fi.relpath, Finding(
+                            rule="accumulation-bound", path=fi.relpath,
+                            line=node.lineno,
+                            message=(
+                                f"{fi.qualname}: declared accum_terms="
+                                f"{terms} exceeds the {acc_cls} "
+                                f"mantissa bound 2**{_MANTISSA[acc_cls]}"
+                                f" — the sum loses integer exactness "
+                                f"before the bound is reached"),
+                            context=f"{fi.qualname}:accum-over:{label}")))
+            # (3) reduction-order-determinism
+            if isinstance(node, ast.Call) and key in df.spmd_reachable:
+                leaf = dfmod._leaf(node.func)
+                if leaf in _ORDER_COLLECTIVES or leaf in _ORDER_SEGMENTS:
+                    opnd = env.classify(node.args[0]) if node.args \
+                        else None
+                    # integer/bool operands are exact under any
+                    # grouping; NEUTRAL is a python literal (device
+                    # counting via psum(1) — exact small constants)
+                    if opnd not in (_INTS | {BOOL, NEUTRAL}) \
+                            and not o_ann:
+                        out.append((fi.relpath, Finding(
+                            rule="reduction-order-determinism",
+                            path=fi.relpath, line=node.lineno,
+                            message=(
+                                f"{fi.qualname}: {leaf}() over a "
+                                f"{opnd or 'non-provable'} dtype inside "
+                                f"a shard_map closure — the reduction "
+                                f"grouping depends on mesh shape; "
+                                f"declare @order_insensitive("
+                                f"tolerance=...) (certified at 1/2/4/8 "
+                                f"devices) or make the operand "
+                                f"integer/exact"),
+                            context=f"{fi.qualname}:order:{leaf}")))
+            # (4) mixed-dtype-comparison (Pallas bodies only)
+            if isinstance(node, ast.Compare) and key in pallas_keys:
+                sides = [node.left] + list(node.comparators)
+                classes = [env.classify(s) for s in sides]
+                if F32 in classes and F64 in classes:
+                    out.append((fi.relpath, Finding(
+                        rule="mixed-dtype-comparison", path=fi.relpath,
+                        line=node.lineno,
+                        message=(f"{fi.qualname}: comparison mixes f32 "
+                                 f"and f64 operands inside a Pallas "
+                                 f"body — the implicit promotion "
+                                 f"differs across backends"),
+                        context=f"{fi.qualname}:cmp:f32f64")))
+                else:
+                    for s in sides:
+                        if env.is_int_float_cast(s):
+                            out.append((fi.relpath, Finding(
+                                rule="mixed-dtype-comparison",
+                                path=fi.relpath, line=node.lineno,
+                                message=(
+                                    f"{fi.qualname}: an integer value "
+                                    f"is cast to float to feed a "
+                                    f"comparison inside a Pallas body "
+                                    f"— past 2**24 the rounding flips "
+                                    f"branches between backends; "
+                                    f"compare in integer space"),
+                                context=(f"{fi.qualname}:cmp:"
+                                         f"intcast"))))
+                            break
+    return out
+
+
+def _accum_site(node, env: _DtypeEnv
+                ) -> Optional[Tuple[Optional[str], str]]:
+    """(accumulator dtype class, label) when ``node`` is a reduction
+    that accumulates; None otherwise. A ``dtype=`` kwarg on the
+    reduction is the accumulator (the f64-accumulate escape)."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+        return _widest([env.classify(node.left),
+                        env.classify(node.right)]), "matmul(@)"
+    if not isinstance(node, ast.Call):
+        return None
+    leaf = dfmod._leaf(node.func)
+    if leaf not in _ACCUM_LEAVES:
+        return None
+    # require a plausible numeric base (jnp/np/lax) or bare name import
+    if isinstance(node.func, ast.Attribute):
+        d = dfmod._dotted(node.func) or ""
+        base = d.split(".", 1)[0]
+        if base not in ("jnp", "np", "jax", "lax", "numpy"):
+            return None
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            cls = _dtype_class_of_expr(kw.value, env.aliases)
+            if cls is not None:
+                return cls, f"{leaf}()"
+    if leaf in ("dot", "matmul", "einsum"):
+        cls = _widest([env.classify(a) for a in node.args
+                       if not isinstance(a, ast.Constant)] or [None])
+        return cls, f"{leaf}()"
+    if not node.args:
+        return None
+    return env.classify(node.args[0]), f"{leaf}()"

@@ -135,6 +135,34 @@ def _soak_findings(root: str) -> List[Tuple[Optional[str], Finding]]:
                 ts.append(t * 1000)
                 vals.append(v)
             ref.append(RefSeries(dict(labels), ts, vals))
+    # classic-bucket histogram world for the generator's
+    # histogram_quantile shapes (v4 widening): complete cumulative
+    # bucket sets per (job, instance), monotone across le
+    les = ("0.1", "0.5", "1", "2.5", "+Inf")
+    for job in ("api", "web"):
+        for inst in ("i0", "i1"):
+            cum = [0.0] * len(les)
+            per_le = {le: ([], []) for le in les}
+            for k in range(140):
+                t = t0 + k * 10
+                if rng.random() < 0.04:
+                    continue
+                run = 0.0
+                for bi, le in enumerate(les):
+                    run += rng.random() * 2
+                    cum[bi] += run
+                    per_le[le][0].append(t * 1000)
+                    per_le[le][1].append(cum[bi])
+            for le in les:
+                labels = {
+                    "_metric_": "http_request_duration_seconds_bucket",
+                    "_ws_": "demo", "_ns_": "App-0", "job": job,
+                    "instance": inst, "le": le}
+                hts, hvals = per_le[le]
+                for t, v in zip(hts, hvals):
+                    b.add_sample("prom-counter", labels, t, v)
+                ref.append(RefSeries(dict(labels), list(hts),
+                                     list(hvals)))
     for c in b.containers():
         shard.ingest(c)
     shard.flush_all()

@@ -130,6 +130,7 @@ def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
 
 from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
+from filodb_tpu.lint.numerics import order_insensitive
 from filodb_tpu.query.model import RangeParams, RawSeries
 from filodb_tpu.query.tpu import (_GATHER_FUNCS, _TS_PAD, TpuBackend,
                                   _window_endpoint, _window_gather,
@@ -209,6 +210,15 @@ def _grouped_reduce_check():
     return None
 
 
+@order_insensitive(
+    "grouped-reduce-psum", tolerance=1e-12,
+    reason="the sum/avg family psums f64 per-device partial "
+           "aggregates whose grouping follows the shard-axis device "
+           "count; each per-device partial is a one-hot matmul of at "
+           "most S/n_dev f64 terms, so regrouping moves the result by "
+           "at most a few f64 ulps — certified at 1/2/4/8 virtual "
+           "devices. min/max ride pmin/pmax (order-free) and counts "
+           "are integers in f64 (exact below 2**53)")
 @kernel_contract(
     "mesh_grouped_reduce", kind="shard_map",
     check=_grouped_reduce_check,

@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.numerics import order_insensitive, precision
 from filodb_tpu.parallel.mesh import (_grouped_reduce, _shard_map, make_mesh,
                                       resolve_spec)
 
@@ -85,6 +86,14 @@ def _jit_lookup(key: Tuple, build, cost_args=None):
 # Donated refresh step
 # ---------------------------------------------------------------------------
 
+@precision(
+    "append-carry-exact", bits=53, rel_ulps=0,
+    reason="the donated append extends the counter-corrected channel "
+           "in exact f64: absent counter resets in the appended block "
+           "the carry and cumsum terms are all zero, so the refreshed "
+           "channel is BITWISE the from-scratch rebuild (certified); "
+           "with resets the carry value itself is still exact, only "
+           "the add order differs from a rebuild")
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def _append_step(tsr, v, cv, new_tsr, new_v, n_filled):
     """Zero-copy slot append: write a flush's new slot columns into the
@@ -226,6 +235,13 @@ def _build_aligned_eval(mesh: Mesh, func: str, nsteps_local: int,
     return run
 
 
+@order_insensitive(
+    "grouped-pair-psum", tolerance=1e-12,
+    reason="sums and counts are f64 per-device one-hot matmul "
+           "partials psummed over the shard axis; regrouping across "
+           "device counts moves the sums by at most a few f64 ulps "
+           "(counts are exact integers in f64) — certified at "
+           "1/2/4/8 virtual devices")
 def _build_grouped_pair_eval(mesh: Mesh, func: str, nsteps_local: int,
                              num_groups: int):
     """The fused-groupsum contract from resident tiles: per-device

@@ -57,6 +57,13 @@ DEFAULT_METRICS: Tuple[MetricSpec, ...] = (
                 ("instance", ("i0", "i1", "i2")))),
 )
 
+# classic-bucket histogram metric: generated histogram_quantile shapes
+# select it WITHOUT an `le` matcher so every bucket set stays complete
+DEFAULT_HISTOGRAM = MetricSpec(
+    "http_request_duration_seconds_bucket", "counter",
+    (("job", ("api", "web")), ("instance", ("i0", "i1"))))
+_HIST_QS = ("0.5", "0.9", "0.95", "0.99")
+
 _COUNTER_FNS = ("rate", "increase", "irate", "resets", "changes")
 _GAUGE_FNS = ("delta", "idelta", "deriv", "avg_over_time",
               "min_over_time", "max_over_time", "sum_over_time",
@@ -81,15 +88,26 @@ _SUBQ_FNS = ("avg_over_time", "max_over_time", "min_over_time",
 class QueryGen:
     """Seeded well-typed query generator over a metric universe."""
 
+    _HIST_DEFAULT = object()    # sentinel: follow the metric universe
+
     def __init__(self, seed: int = 0,
                  metrics: Sequence[MetricSpec] = DEFAULT_METRICS,
-                 max_depth: int = 3, validate: bool = True):
+                 max_depth: int = 3, validate: bool = True,
+                 histogram=_HIST_DEFAULT):
         self.rng = random.Random(seed)
         self.metrics = list(metrics)
+        # the default bucket metric rides only the DEFAULT universe; a
+        # custom universe opts in by passing histogram= explicitly
+        if histogram is QueryGen._HIST_DEFAULT:
+            histogram = DEFAULT_HISTOGRAM \
+                if tuple(metrics) == DEFAULT_METRICS else None
+        self.histogram: Optional[MetricSpec] = histogram
         self.max_depth = max_depth
         self.validate = validate
-        self.schemas = semant.MetricSchemas(
-            {m.name: m.kind for m in self.metrics})
+        known = {m.name: m.kind for m in self.metrics}
+        if histogram is not None:
+            known[histogram.name] = histogram.kind
+        self.schemas = semant.MetricSchemas(known)
         # the validation range only needs to typecheck plan building
         self._params = TimeStepParams(1_600_000_000, 30, 1_600_000_600)
 
@@ -206,6 +224,56 @@ class QueryGen:
         return (f"({self._selector(m)} {op} "
                 f"{self._selector(m)})")
 
+    def _histogram_expr(self, depth: int) -> str:
+        """histogram_quantile over the classic-bucket metric: the
+        float-compare + bucket-interpolation shape. The inner is
+        rate()/increase() on the bucket counters, optionally re-summed
+        by (le, ...) — `le` always survives so every group keeps a
+        complete cumulative histogram."""
+        m = self.histogram
+        q = self._pick(_HIST_QS)
+        w = self._pick(_WINDOWS)
+        fn = self._pick(("rate", "increase"))
+        inner = f"{fn}({self._selector(m, w)})"
+        if self.rng.random() < 0.5:
+            keep = self._pick(("le", "le,job", "le,instance"))
+            inner = f"sum by ({keep}) ({inner})"
+        return f"histogram_quantile({q}, {inner})"
+
+    def _topk_expr(self, depth: int) -> str:
+        """topk/bottomk over a CONTINUOUS-valued inner (rate/deriv/
+        avg_over_time): partial-sort determinism is only well-defined
+        engine-vs-reference when per-step ties have measure zero, so
+        discrete-valued inners (counts, present) stay out."""
+        op = self._pick(("topk", "bottomk"))
+        k = self._pick(("1", "2", "3"))
+        m = self._metric()
+        w = self._pick(_WINDOWS)
+        if m.kind == "counter":
+            inner = f"{self._pick(('rate', 'increase'))}({self._selector(m, w)})"
+        else:
+            inner = f"{self._pick(('avg_over_time', 'deriv'))}({self._selector(m, w)})"
+        return f"{op}({k}, {inner})"
+
+    def _grouped_join_expr(self, depth: int) -> str:
+        """many-to-one join: the 'many' side keeps full series labels,
+        the 'one' side is aggregated to exactly the match key, so the
+        join is provably many-to-one (semant's group_* rules pass by
+        construction)."""
+        labels = ("job",) if self.rng.random() < 0.5 else ("instance",)
+        ls = ",".join(labels)
+        m = self._metric("counter")
+        w = self._pick(_WINDOWS)
+        many = f"{self._pick(('rate', 'increase'))}({self._selector(m, w)})"
+        one_m = self._metric("counter")
+        one = (f"sum by ({ls}) "
+               f"({self._pick(('rate', 'increase'))}"
+               f"({self._selector(one_m, self._pick(_WINDOWS))}))")
+        op = self._pick(("/", "*", "+", "-"))
+        if self.rng.random() < 0.5:
+            return f"({many} {op} on ({ls}) group_left {one})"
+        return f"({one} {op} on ({ls}) group_right {many})"
+
     def _instant_fn_expr(self, depth: int) -> str:
         fn = self._pick(_INSTANT_FNS)
         inner = self._vector(depth - 1)
@@ -225,14 +293,20 @@ class QueryGen:
                 return self._selector(self._metric("gauge"))
             return self._range_fn_expr(0)
         r = self.rng.random()
-        if r < 0.3:
+        if r < 0.27:
             return self._range_fn_expr(depth)
-        if r < 0.55:
+        if r < 0.48:
             return self._agg_expr(depth)
-        if r < 0.75 and allow_binop:
+        if r < 0.66 and allow_binop:
             return self._binop_expr(depth)
-        if r < 0.9:
+        if r < 0.78:
             return self._instant_fn_expr(depth)
+        if r < 0.84 and self.histogram is not None:
+            return self._histogram_expr(depth)
+        if r < 0.9:
+            return self._topk_expr(depth)
+        if r < 0.95 and allow_binop:
+            return self._grouped_join_expr(depth)
         return self._selector(self._metric("gauge"))
 
     # -- public ----------------------------------------------------------

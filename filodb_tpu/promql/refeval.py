@@ -18,10 +18,14 @@ one of them and lands as a pinned regression test.
 
 Scope: the generator's surface — selectors, the rate/over_time range
 families, subqueries, sum/avg/min/max/count/group/stddev/stdvar
-aggregations with by/without, scalar and vector binary operators
-(incl. bool / filtering comparisons and and/or/unless), the pure
-instant functions, offsets, scalar()/vector()/time(). Histograms,
-topk/sort/label_replace and @-pinning are engine-test territory.
+aggregations with by/without, topk/bottomk (per-step top-k selection
+keeping the member series), scalar and vector binary operators
+(incl. bool / filtering comparisons, and/or/unless, and
+group_left/group_right many-to-one joins with include labels), the
+pure instant functions, classic-bucket ``histogram_quantile`` (the
+`le`-series join with Prometheus bucket interpolation), offsets,
+scalar()/vector()/time(). sort/label_replace, native-histogram
+columns and @-pinning remain engine-test territory.
 """
 
 from __future__ import annotations
@@ -355,6 +359,37 @@ def eval_instant_fn(func: str, v: float, args: Sequence[float]) -> float:
     raise RefEvalError(f"instant function {func} outside refeval scope")
 
 
+def _bucket_quantile(q: float, les: List[float],
+                     cum: List[float]) -> float:
+    """Prometheus bucketQuantile over one cumulative histogram column
+    (pure-Python mirror of memory/histogram.quantile — the engine's
+    bucket math — audited against Histogram.scala:17)."""
+    if not 0 <= q <= 1:
+        return INF if q > 1 else -INF
+    if len(les) < 2:
+        return NAN
+    total = cum[-1]
+    if total == 0 or _isnan(total):
+        return NAN
+    rank = q * total
+    b = 0                               # searchsorted(cum, rank, 'left')
+    while b < len(cum) and cum[b] < rank:
+        b += 1
+    b = min(b, len(les) - 1)
+    if b == len(les) - 1:
+        return float(les[-2])
+    if b == 0 and les[0] <= 0:
+        return float(les[0])
+    bucket_start = 0.0 if b == 0 else float(les[b - 1])
+    bucket_end = float(les[b])
+    count_start = 0.0 if b == 0 else float(cum[b - 1])
+    count_end = float(cum[b])
+    if count_end == count_start:
+        return bucket_end
+    return bucket_start + (bucket_end - bucket_start) * \
+        (rank - count_start) / (count_end - count_start)
+
+
 # ---------------------------------------------------------------------------
 # the evaluator
 # ---------------------------------------------------------------------------
@@ -497,9 +532,68 @@ class RefEvaluator:
             return _Vec([({}, list(s))])
         if name in pp.RANGE_FN_NAMES:
             return self._range_call(node, grid)
+        if name == "histogram_quantile":
+            return self._histogram_quantile(node, grid)
         if name in pp.INSTANT_FNS:
             return self._instant_call(node, grid)
         raise RefEvalError(f"function {name} outside refeval scope")
+
+    def _histogram_quantile(self, node: pp.Call, grid: List[int]) -> _Vec:
+        """Classic per-bucket series: join series sharing all labels
+        except `le` into one cumulative histogram per step (the
+        engine's _quantile_over_le_series — stale bucket samples
+        dropped per step, +Inf bucket required, running-max
+        monotonicity, Prometheus bucket interpolation)."""
+        q_steps = self._eval(node.args[0], grid)
+        if isinstance(q_steps, (_Vec, str)):
+            raise RefEvalError("histogram_quantile non-scalar q")
+        q = q_steps[0]
+        v = self._eval(node.args[1], grid)
+        if not isinstance(v, _Vec):
+            raise RefEvalError("histogram_quantile over a scalar")
+        groups: Dict[Tuple, List[Tuple[float, List[float]]]] = {}
+        order: List[Tuple] = []
+        for labels, row in v.rows:
+            le_s = labels.get("le")
+            if le_s is None:
+                continue        # non-bucket series ignored (engine too)
+            try:
+                le = float(str(le_s).replace("+Inf", "inf"))
+            except ValueError:
+                continue
+            base = tuple(sorted((k, val) for k, val
+                                in _strip_metric(labels).items()
+                                if k != "le"))
+            if base not in groups:
+                groups[base] = []
+                order.append(base)
+            groups[base].append((le, row))
+        if not groups:
+            raise RefEvalError("histogram_quantile requires per-bucket "
+                               "series with an 'le' label")
+        rows = []
+        for base in order:
+            members = sorted(groups[base], key=lambda m: m[0])
+            les = [m[0] for m in members]
+            vals = []
+            for i in range(len(grid)):
+                col = [(le, r[i]) for le, r in members
+                       if not _isnan(r[i])]
+                if not col:
+                    vals.append(NAN)
+                    continue
+                lc = [le for le, _x in col]
+                if not math.isinf(lc[-1]) or lc[-1] < 0:
+                    vals.append(NAN)    # no +Inf sample: NaN
+                    continue
+                # running max down the buckets (ensureMonotonic)
+                cum, run = [], -INF
+                for _le, x in col:
+                    run = max(run, x)
+                    cum.append(run)
+                vals.append(_bucket_quantile(q, lc, cum))
+            rows.append((dict(base), vals))
+        return _Vec(rows)
 
     def _range_call(self, node: pp.Call, grid: List[int]) -> _Vec:
         name = node.name
@@ -562,6 +656,8 @@ class RefEvaluator:
         if not isinstance(inner, _Vec):
             raise RefEvalError("aggregation over a scalar")
         op = node.op
+        if op in ("topk", "bottomk"):
+            return self._topk(node, inner, grid, bottom=(op == "bottomk"))
         groups: Dict[Tuple, Tuple[Dict[str, str], List[List[float]]]] = {}
         order: List[Tuple] = []
         for labels, row in inner.rows:
@@ -586,6 +682,55 @@ class RefEvaluator:
                 xs = [row[i] for row in members if not _isnan(row[i])]
                 vals.append(self._agg_step(op, xs))
             rows.append((gk, vals))
+        return _Vec(rows)
+
+    def _topk(self, node: pp.Agg, inner: _Vec, grid: List[int],
+              bottom: bool) -> _Vec:
+        """topk/bottomk: per step, keep the k best series per group;
+        output is the union of selected series (FULL labels, like the
+        engine's TopBottomK) with NaN at unselected steps."""
+        if not node.params:
+            raise RefEvalError(f"{node.op} requires a k parameter")
+        p = node.params[0]
+        if not isinstance(p, pp.NumLit):
+            raise RefEvalError(f"{node.op} non-literal k outside scope")
+        k = int(p.value)
+        # group like the engine (stripped labels), keep member rows
+        groups: Dict[Tuple, List[int]] = {}
+        order: List[Tuple] = []
+        for i, (labels, _row) in enumerate(inner.rows):
+            l2 = _strip_metric(labels)
+            if node.by:
+                gk = tuple(sorted((l, l2[l]) for l in node.by
+                                  if l in l2))
+            elif node.without:
+                gk = tuple(sorted((l, v) for l, v in l2.items()
+                                  if l not in node.without))
+            else:
+                gk = ()
+            if gk not in groups:
+                groups[gk] = []
+                order.append(gk)
+            groups[gk].append(i)
+        rows = []
+        for gk in order:
+            idx = groups[gk]
+            keep = {i: [False] * len(grid) for i in idx}
+            for t in range(len(grid)):
+                present = [(inner.rows[i][1][t], i) for i in idx
+                           if not _isnan(inner.rows[i][1][t])]
+                # stable per-step selection: best value first, input
+                # order breaks ties (the engine's stable argsort)
+                present.sort(key=lambda pv: pv[0],
+                             reverse=not bottom)
+                for _v, i in present[:k]:
+                    keep[i][t] = True
+            for i in idx:
+                if any(keep[i]):
+                    labels, row = inner.rows[i]
+                    rows.append((dict(labels),
+                                 [x if keep[i][t] else NAN
+                                  for t, x in enumerate(row)]))
         return _Vec(rows)
 
     @staticmethod
@@ -649,7 +794,7 @@ class RefEvaluator:
 
     def _vector_join(self, node: pp.BinOp, lhs: _Vec, rhs: _Vec) -> _Vec:
         if node.group_left or node.group_right:
-            raise RefEvalError("grouped joins outside refeval scope")
+            return self._grouped_join(node, lhs, rhs)
         rmap: Dict[Tuple, Tuple[Dict[str, str], List[float]]] = {}
         for labels, row in rhs.rows:
             k = self._join_key(labels, node.on, node.ignoring)
@@ -669,6 +814,41 @@ class RefEvaluator:
             out = [_apply_op(node.op, a, b, node.return_bool)
                    for a, b in zip(row, got[1])]
             rows.append((_strip_metric(labels), out))
+        return _Vec(rows)
+
+    def _grouped_join(self, node: pp.BinOp, lhs: _Vec, rhs: _Vec) -> _Vec:
+        """Many-to-one / one-to-many join (the engine's BinaryJoinExec
+        grouped path): operands keep their ORIGINAL sides, output
+        labels come from the 'many' side, include labels are copied
+        from the 'one' side (or dropped when absent there), and a
+        duplicate series on the 'one' side is a many-to-many error."""
+        many, one = (lhs, rhs) if node.group_left else (rhs, lhs)
+        omap: Dict[Tuple, Tuple[Dict[str, str], List[float]]] = {}
+        for labels, row in one.rows:
+            k = self._join_key(labels, node.on, node.ignoring)
+            if k in omap:
+                raise RefEvalError(
+                    "many-to-many join: duplicate series on 'one' side")
+            omap[k] = (labels, row)
+        rows = []
+        for labels, row in many.rows:
+            k = self._join_key(labels, node.on, node.ignoring)
+            got = omap.get(k)
+            if got is None:
+                continue
+            if node.group_left:
+                a_row, b_row = row, got[1]
+            else:
+                a_row, b_row = got[1], row
+            out = [_apply_op(node.op, a, b, node.return_bool)
+                   for a, b in zip(a_row, b_row)]
+            l2 = dict(_strip_metric(labels))
+            for l in node.include:
+                if l in got[0]:
+                    l2[l] = got[0][l]
+                else:
+                    l2.pop(l, None)
+            rows.append((l2, out))
         return _Vec(rows)
 
     def _set_op(self, op: str, lhs, rhs, node: pp.BinOp) -> _Vec:

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from filodb_tpu.lint.contracts import ANY, SEM, SMEM, Block, kernel_contract
+from filodb_tpu.lint.numerics import precision
 
 # jax dropped / moved the top-level enable_x64 context manager across
 # versions; resolve whichever this install provides
@@ -450,6 +451,16 @@ def _groupsum_expect(out):
 # length, modest group count. The dispatcher trades streams against
 # [T, G] accumulator size; this declaration pins the largest shape on
 # the stream-heavy side of that frontier.
+@precision(
+    "groupsum-recombine-f32", bits=61, rel_ulps=4,
+    reason="boundary deltas are exact int32 subtractions of the "
+           "fixed-point hi/lo planes; the f32 recombine "
+           "dh*2^(31-s) + dl*2^-s rounds relative to the delta (wide "
+           "deltas also round dl itself into f32), bounded by a few "
+           "f32 ulps plus the span*2^-59 quantization floor — "
+           "certified against the direct f64 delta over full-span "
+           "boundary pairs; branch decisions stay in integer space "
+           "(exact_branch), which mixed-dtype-comparison polices")
 @kernel_contract(
     "counter_groupsum", kind="pallas",
     grid=(8,),

@@ -39,6 +39,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.contracts import kernel_contract
+from filodb_tpu.lint.numerics import order_insensitive, precision  # noqa: F401
 from filodb_tpu.query.model import RawSeries
 
 # functions servable from aligned tiles (everything endpoint- or
@@ -359,6 +360,14 @@ class AlignedTiles:
             self._tperm[key] = c
         return c
 
+    @precision(
+        "fixed-point-split", bits=61, rel_ulps=4,
+        reason="exact int32 hi/lo split: |v - mid| * 2**s <= 2**60, so "
+               "boundary subtractions in the group-sum kernel are "
+               "exact integer ops; only the final f32 recombine "
+               "rounds, relative to the delta, with a fixed-point "
+               "quantization floor of span * 2**-59 — certified "
+               "against the direct f64 delta")
     def _fixed_channels(self, vch: str):
         """Per-series 61-bit fixed-point encoding of a value channel for
         the group-sum kernel: each series is rebased to its in-tile
@@ -774,6 +783,13 @@ def _tiles_arrays_t(tiles: AlignedTiles, func: str) -> Dict[str, jnp.ndarray]:
     }
 
 
+@precision(
+    "counter-exact-slot-index", bits=31, rel_ulps=4,
+    reason="the i64->i32 casts narrow SLOT indices, each clipped to "
+           "[0, num_slots] first (num_slots < 2**31 by construction); "
+           "the value math stays f64 end to end — certified against "
+           "the pure-Python per-window reference evaluator "
+           "(promql/refeval) to a few f64 ulps")
 def _eval_counter_t(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
                     num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
     """rate/increase/delta over transposed tiles → [T, S] f64.
@@ -861,6 +877,15 @@ def _tiles_arrays_fast(tiles: AlignedTiles, func: str
     }
 
 
+@precision(
+    "counter-fast-hybrid", bits=31, rel_ulps=16,
+    reason="the int31 span-guard idiom: the dispatcher "
+           "(_slide_eligible / ShardedTiles.query_fits) proves the "
+           "whole query grid fits int32 ms relative to the tile base "
+           "before the i64->i32 timestamp narrowing; boundary deltas "
+           "stay exact f64 and only the extrapolation epilogue runs "
+           "f32 — certified against the exact-f64 evaluator "
+           "(_eval_counter_t) within 16 f32 ulps")
 def _eval_counter_fast(func: str, nsteps: int, arrs: Dict[str, jnp.ndarray],
                        num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
     """rate/increase/delta over transposed tiles → [T, S] **f32**.
@@ -961,6 +986,14 @@ def _tiles_arrays_slide(tiles: AlignedTiles, func: str, st: int
     }
 
 
+@precision(
+    "counter-slide-hybrid", bits=31, rel_ulps=16,
+    reason="same hybrid numerics as counter-fast-hybrid (int32 "
+           "relative timestamps under the _slide_eligible span guard, "
+           "exact f64 boundary deltas, f32 epilogue); the stride-"
+           "permuted dynamic_slice changes only the memory access "
+           "pattern — certified against the exact-f64 evaluator "
+           "within the same 16 f32 ulps")
 def _eval_counter_slide(func: str, nsteps: int, st: int,
                         arrs: Dict[str, jnp.ndarray],
                         num_slots, base, dt, w0s, w0e, step) -> jnp.ndarray:
@@ -1012,6 +1045,15 @@ def _eval_counter_slide(func: str, nsteps: int, st: int,
                          (w0e - w0s).astype(jnp.float32) / 1000.0)
 
 
+@precision(
+    "counter-epilogue-f32", bits=24, rel_ulps=4,
+    reason="the extrapolation epilogue narrows the exact f64 boundary "
+           "delta and exact i32 time differences to f32 for the "
+           "division chain (native TPU rate vs software-emulated "
+           "f64); certified within 4 f32 ulps of the f64-reference "
+           "formula — XLA lowers the chain per-program, so two "
+           "programs (mesh-on vs mesh-off instant queries) may differ "
+           "by at most twice that budget (rel_bound(cross_program))")
 def _f32_epilogue(func, counts, t1, v1, t2, v2, wstart_r, wend_r, wdur_s):
     """Shared f32 extrapolation epilogue: exact f64 delta, f32 factor."""
     f32 = jnp.float32

@@ -43,6 +43,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.caches import cache_registry
 from filodb_tpu.lint.contracts import kernel_contract
+from filodb_tpu.lint.numerics import precision
 from filodb_tpu.lint.hotpath import hot_path
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import devprof
@@ -242,6 +243,13 @@ def _correction(vals, lens):
     return jnp.cumsum(drops, axis=1)
 
 
+@precision(
+    "extrapolated-rate-f64", bits=53, rel_ulps=4,
+    reason="the shared f64 extrapolation formula every exact counter "
+           "path funnels through; certified within a few f64 ulps of "
+           "the pure-Python reference (promql/refeval._extrapolated) "
+           "— the two arms of the differential rail agree at the "
+           "formula level, not just end to end")
 def _extrapolated_rate(wstart, wend, counts, t1, v1, t2, v2, is_counter,
                        is_rate):
     """(rangefn/RateFunctions.scala:37 extrapolatedRate, on device.)

@@ -1097,7 +1097,7 @@ mesh = Mesh(jax.devices(), ("shard",))
                    out_specs=P())
 def f(x):
     if jax.process_index() == 0:
-        return jax.lax.psum(x, "shard")
+        return jax.lax.psum(x.astype(jax.numpy.int32), "shard")
     return x
 """
 
@@ -1116,7 +1116,7 @@ def run(x, agg):
     @functools.partial(jax.shard_map, mesh=mesh,
                        in_specs=(P("shard"),), out_specs=P())
     def inner(x):
-        out = jax.lax.psum(x, "shard")     # unconditional: balanced
+        out = jax.lax.psum(x.astype(jax.numpy.int32), "shard")
         if agg == "mean":
             out = out / jax.lax.psum(1.0, "shard")
         return out
@@ -1135,7 +1135,7 @@ mesh = Mesh(jax.devices(), ("shard",))
 def f(x):
     if jax.process_index() == 0:
         # graftlint: disable=spmd-collective-balance (single-host test rig)
-        return jax.lax.psum(x, "shard")
+        return jax.lax.psum(x.astype(jax.numpy.int32), "shard")
     return x
 """
 
@@ -1158,7 +1158,7 @@ mesh = Mesh(jax.devices(), ("shard", "time"))
 @functools.partial(jax.shard_map, mesh=mesh,
                    in_specs=(P("shard"),), out_specs=P())
 def f(x):
-    return jax.lax.psum(x, "shards")
+    return jax.lax.psum(x.astype(jax.numpy.int32), "shards")
 """
 
 
@@ -1176,7 +1176,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 mesh = Mesh(jax.devices(), ("shard",))
 
 def then_branch(x):
-    return jax.lax.psum(x, "shard")
+    return jax.lax.psum(x.astype(jax.numpy.int32), "shard")
 
 @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
                    out_specs=P())
@@ -1573,3 +1573,309 @@ def test_cache_unregistered(tmp_path):
     assert rules_of(lint_src(tmp_path, CACHE_UNREGISTERED)) \
         == ["cache-unregistered"]
     assert not lint_src(tmp_path, CACHE_REGISTERED).findings
+
+
+# -- graftlint v4: numeric-precision & determinism families ------------------
+
+NARROW_VIOLATION = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def grid(n):
+    t = jnp.arange(16, dtype=jnp.int64)
+    rel = t * 60000 + 5
+    return rel.astype(jnp.int32)
+"""
+
+NARROW_CLEAN = """
+import jax
+import jax.numpy as jnp
+from filodb_tpu.lint.numerics import precision
+
+@precision("fixture-span-guard", bits=31, rel_ulps=0,
+           reason="grid proved inside int32 ms by the dispatcher")
+@jax.jit
+def grid(n):
+    t = jnp.arange(16, dtype=jnp.int64)
+    rel = t * 60000 + 5
+    return rel.astype(jnp.int32)
+"""
+
+NARROW_PRAGMA = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def grid(n):
+    t = jnp.arange(16, dtype=jnp.int64)
+    rel = t * 60000 + 5
+    # graftlint: disable=precision-narrowing (fixture: span guarded upstream)
+    return rel.astype(jnp.int32)
+"""
+
+
+def test_precision_narrowing(tmp_path):
+    assert rules_of(lint_src(tmp_path, NARROW_VIOLATION)) \
+        == ["precision-narrowing"]
+    assert not lint_src(tmp_path, NARROW_CLEAN).findings
+    res = lint_src(tmp_path, NARROW_PRAGMA)
+    assert not res.findings and res.suppressed == 1
+
+
+NARROW_F64_VIOLATION = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def shrink(x):
+    v = x.astype(jnp.float64) * 2.0
+    return v.astype(jnp.float32)
+"""
+
+
+def test_precision_narrowing_f64_to_f32(tmp_path):
+    assert rules_of(lint_src(tmp_path, NARROW_F64_VIOLATION)) \
+        == ["precision-narrowing"]
+
+
+ACCUM_VIOLATION = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def total(x):
+    y = x.astype(jnp.float32)
+    return jnp.sum(y)
+"""
+
+ACCUM_CLEAN_ANNOTATED = """
+import jax
+import jax.numpy as jnp
+from filodb_tpu.lint.numerics import precision
+
+@precision("fixture-accum", bits=24, rel_ulps=4, accum_terms=1 << 20,
+           reason="at most 2**20 window terms by the dispatcher bound")
+@jax.jit
+def total(x):
+    y = x.astype(jnp.float32)
+    return jnp.sum(y)
+"""
+
+ACCUM_CLEAN_F64 = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def total(x):
+    y = x.astype(jnp.float32)
+    return jnp.sum(y, dtype=jnp.float64)
+"""
+
+ACCUM_OVERCLAIM = """
+import jax
+import jax.numpy as jnp
+from filodb_tpu.lint.numerics import precision
+
+@precision("fixture-accum-over", bits=24, rel_ulps=4,
+           accum_terms=1 << 30,
+           reason="bound exceeds the f32 mantissa on purpose")
+@jax.jit
+def total(x):
+    y = x.astype(jnp.float32)
+    return jnp.sum(y)
+"""
+
+
+def test_accumulation_bound(tmp_path):
+    assert rules_of(lint_src(tmp_path, ACCUM_VIOLATION)) \
+        == ["accumulation-bound"]
+    assert not lint_src(tmp_path, ACCUM_CLEAN_ANNOTATED).findings
+    assert not lint_src(tmp_path, ACCUM_CLEAN_F64).findings
+    res = lint_src(tmp_path, ACCUM_OVERCLAIM)
+    assert rules_of(res) == ["accumulation-bound"]
+    assert "2**24" in res.findings[0].message
+
+
+ORDER_VIOLATION = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    return jax.lax.psum(x, "shard")
+"""
+
+ORDER_CLEAN_ANNOTATED = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from filodb_tpu.lint.numerics import order_insensitive
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@order_insensitive("fixture-psum", tolerance=1e-12,
+                   reason="f64 partials; a few ulps across regroupings")
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    return jax.lax.psum(x, "shard")
+"""
+
+ORDER_CLEAN_INT = """
+import functools
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    counts = x.astype(jnp.int32)
+    return jax.lax.psum(counts, "shard")
+"""
+
+ORDER_PRAGMA = """
+import functools
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("shard",))
+
+@functools.partial(jax.shard_map, mesh=mesh, in_specs=(P("shard"),),
+                   out_specs=P())
+def f(x):
+    # graftlint: disable=reduction-order-determinism (fixture rig)
+    return jax.lax.psum(x, "shard")
+"""
+
+
+def test_reduction_order_determinism(tmp_path):
+    assert rules_of(lint_src(tmp_path, ORDER_VIOLATION)) \
+        == ["reduction-order-determinism"]
+    assert not lint_src(tmp_path, ORDER_CLEAN_ANNOTATED).findings
+    assert not lint_src(tmp_path, ORDER_CLEAN_INT).findings
+    res = lint_src(tmp_path, ORDER_PRAGMA)
+    assert not res.findings and res.suppressed == 1
+
+
+MIXED_CMP_VIOLATION = """
+import jax
+import jax.numpy as jnp
+
+def kern(x_ref, o_ref):
+    idx = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    fidx = idx.astype(jnp.float32)
+    o_ref[...] = jnp.where(fidx > 3.0, x_ref[...], 0.0)
+"""
+
+MIXED_CMP_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+def kern(x_ref, o_ref):
+    idx = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    o_ref[...] = jnp.where(idx > 3, x_ref[...], 0.0)
+"""
+
+MIXED_CMP_PRAGMA = """
+import jax
+import jax.numpy as jnp
+
+def kern(x_ref, o_ref):
+    idx = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    fidx = idx.astype(jnp.float32)
+    # graftlint: disable=mixed-dtype-comparison (indices bounded < 2**24)
+    o_ref[...] = jnp.where(fidx > 3.0, x_ref[...], 0.0)
+"""
+
+
+def test_mixed_dtype_comparison(tmp_path):
+    res = lint_src(tmp_path, MIXED_CMP_VIOLATION)
+    assert "mixed-dtype-comparison" in rules_of(res)
+    assert not lint_src(tmp_path, MIXED_CMP_CLEAN).findings
+    res = lint_src(tmp_path, MIXED_CMP_PRAGMA)
+    assert not res.findings and res.suppressed >= 1
+
+
+def test_numerics_families_flow_through_json_github_changed(tmp_path):
+    """The v4 families ride the generic reporting rails: --json carries
+    the rule ids, --github renders annotation lines, and report_only
+    (--changed-only) filters findings anchored elsewhere."""
+    from filodb_tpu.lint.ci_annotations import github_annotations
+    res = lint_src(tmp_path, NARROW_VIOLATION)
+    payload = res.to_json()
+    assert payload["exit_code"] == 1
+    assert [f["rule"] for f in payload["findings"]] \
+        == ["precision-narrowing"]
+    lines = github_annotations(payload)
+    assert len(lines) == 1 and "graftlint precision-narrowing" in lines[0]
+    assert lines[0].startswith("::error ")
+    # report_only: same tree, findings anchored outside the changed set
+    # are dropped while the analysis stays whole-program
+    p = tmp_path / "fixture.py"
+    full = run_lint([str(p)], baseline=frozenset(),
+                    check_contracts=False)
+    assert full.findings
+    other = run_lint([str(p)], baseline=frozenset(),
+                     check_contracts=False,
+                     report_only=frozenset(["somewhere/else.py"]))
+    assert not other.findings
+
+
+# -- SARIF (--sarif) ---------------------------------------------------------
+
+def test_sarif_report_shape(tmp_path):
+    """SARIF 2.1.0: findings as results, the FULL rule catalog in the
+    tool driver (every graftlint family), stable fingerprints."""
+    from filodb_tpu.lint import rules
+    from filodb_tpu.lint.ci_annotations import sarif_report
+    res = lint_src(tmp_path, NARROW_VIOLATION)
+    doc = sarif_report(res.to_json())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert ids == set(rules())         # ALL families, not just v4
+    families = {r["properties"]["family"]
+                for r in run["tool"]["driver"]["rules"]}
+    assert {"kernel", "trace", "lock", "concurrency", "spmd", "cache",
+            "promql", "numerics", "meta"} <= families
+    (result,) = run["results"]
+    assert result["ruleId"] == "precision-narrowing"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("fixture.py")
+    assert loc["region"]["startLine"] >= 1
+    assert "graftlint/key" in result["partialFingerprints"]
+
+
+def test_sarif_baselined_as_note():
+    from filodb_tpu.lint.ci_annotations import sarif_report
+    payload = {"findings": [], "baselined": [
+        {"rule": "trace-side-effect", "path": "a.py", "line": 3,
+         "message": "old finding", "severity": "error", "context": "c"}]}
+    doc = sarif_report(payload)
+    (result,) = doc["runs"][0]["results"]
+    assert result["level"] == "note"
+
+
+def test_cli_sarif_flag(tmp_path):
+    import subprocess
+    import sys
+    bad = tmp_path / "bad.py"
+    bad.write_text(NARROW_VIOLATION)
+    proc = subprocess.run(
+        [sys.executable, "-m", "filodb_tpu.lint", "--sarif",
+         "--no-contracts", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "precision-narrowing"

@@ -48,6 +48,31 @@ def test_v3_families_enabled_at_error():
     assert cat["donation-missing"].severity == "warning"
 
 
+def test_v4_families_enabled_at_error():
+    """The four graftlint v4 numerics families + the ulp-certification
+    rail ride the tier-1 gate at error severity. The full run above
+    exercises them: the tree sweep covers every traced/pallas body and
+    check_contracts=True runs the certification rail over every
+    @precision/@order_insensitive annotation (order claims at 1/2/4/8
+    virtual devices)."""
+    from filodb_tpu.lint import rules
+    cat = rules()
+    for rid in ("precision-narrowing", "accumulation-bound",
+                "reduction-order-determinism", "mixed-dtype-comparison",
+                "ulp-certification"):
+        assert cat[rid].severity == "error"
+
+
+def test_tree_annotations_all_certified():
+    """Belt-and-braces alongside the run_lint sweep: the certification
+    results themselves (memoized from the gate run) are all green."""
+    from filodb_tpu.lint import ulpcert
+    results = ulpcert.certify_all()
+    assert len(results) >= 8
+    bad = [r for r in results if not r.ok]
+    assert not bad, bad
+
+
 def test_shipped_baseline_is_empty():
     with open(baseline_path()) as f:
         data = json.load(f)

@@ -61,6 +61,32 @@ def _build():
                     ts.append(t * 1000)
                     vals.append(v)
                 ref.append(RefSeries(dict(labels), ts, vals))
+    # classic-bucket histogram: cumulative bucket counters, monotone
+    # across le per scrape (a complete set per job/instance)
+    les = ("0.1", "0.5", "1", "2.5", "+Inf")
+    for job in ("api", "web"):
+        for inst in ("i0", "i1"):
+            cum = [0.0] * len(les)
+            series = {le: ([], []) for le in les}
+            for k in range(240):
+                t = T0 + k * 10
+                if rng.random() < 0.05:
+                    continue                    # whole-scrape gap
+                incs = [rng.random() * 3 for _ in les]
+                run = 0.0
+                for bi, le in enumerate(les):
+                    run += incs[bi]             # cumulative across le
+                    cum[bi] += run
+                    series[le][0].append(t * 1000)
+                    series[le][1].append(cum[bi])
+            for le in les:
+                labels = {"_metric_": "http_request_duration_seconds_bucket",
+                          "_ws_": "demo", "_ns_": "App-0", "job": job,
+                          "instance": inst, "le": le}
+                ts, vals = series[le]
+                for t, v in zip(ts, vals):
+                    b.add_sample("prom-counter", labels, t, v)
+                ref.append(RefSeries(dict(labels), list(ts), list(vals)))
     for metric in ("cpu_usage", "queue_depth"):
         for job in (("api", "web") if metric == "cpu_usage"
                     else ("api",)):
@@ -168,10 +194,11 @@ def test_differential_soak_result_cache(world):
 
 def test_differential_refeval_rejects_out_of_scope(world):
     """The reference evaluator fails LOUDLY outside its scope instead
-    of silently passing a vacuous comparison."""
+    of silently passing a vacuous comparison. (topk moved INTO scope
+    with the v4 widening — quantile() remains out.)"""
     _shard, ref = world
     with pytest.raises(RefEvalError):
-        ref_eval("topk(2, cpu_usage)", ref, START, STEP, END)
+        ref_eval("quantile(0.9, cpu_usage)", ref, START, STEP, END)
 
 
 # ---------------------------------------------------------------------------
@@ -225,3 +252,66 @@ def test_pinned_rebase_subquery_node_directly():
         (500_000, 30_000, 2_000_000)
     assert moved.window_ms == 600_000 and moved.function == \
         "avg_over_time"
+
+
+# ---------------------------------------------------------------------------
+# v4 widening: histogram_quantile, grouped joins, topk (ROADMAP 5
+# remainder) — the shapes that exercise float-compare and partial-sort
+# determinism the graftlint v4 numerics families reason about
+# ---------------------------------------------------------------------------
+
+def test_soak_stream_covers_new_shapes():
+    """The seeded soak stream actually exercises the widened surface —
+    the coverage is not vacuous."""
+    g = QueryGen(seed=SOAK_SEED)
+    qs = g.queries(SOAK_N)
+    assert any("histogram_quantile" in q for q in qs)
+    assert any("topk(" in q or "bottomk(" in q for q in qs)
+    assert any("group_left" in q or "group_right" in q for q in qs)
+
+
+def _one(world, q):
+    shard, ref = world
+    plan = parse_query_range(q, TimeStepParams(START, STEP, END))
+    eng = _canon(QueryEngine([shard]).execute(plan))
+    rf = ref_eval(q, ref, START, STEP, END)
+    _compare("pinned", q, eng, rf)
+    return eng
+
+
+def test_pinned_histogram_quantile_bucket_join(world):
+    """Classic-bucket histogram_quantile: the le-series join, running-
+    max monotonicity, and bucket interpolation agree engine-vs-
+    reference (including through a by-(le,job) re-aggregation)."""
+    eng = _one(world, 'histogram_quantile(0.9, '
+               'rate(http_request_duration_seconds_bucket[5m]))')
+    assert eng, "no histogram groups came back"
+    assert all("le" not in dict(k) for k in eng)
+    finite = [v for row in eng.values() for v in row
+              if not math.isnan(v)]
+    assert finite and all(0 <= v <= 2.5 for v in finite)
+    _one(world, 'histogram_quantile(0.5, sum by (le,job) '
+         '(rate(http_request_duration_seconds_bucket[2m])))')
+
+
+def test_pinned_grouped_join(world):
+    """group_left/group_right many-to-one joins: original operand
+    sides, many-side labels, duplicate-one-side detection."""
+    eng = _one(world, '(rate(errors_total[5m]) / on (job) group_left '
+               'sum by (job) (rate(http_requests_total[5m])))')
+    # many side labels survive (job AND instance)
+    assert all("instance" in dict(k) for k in eng)
+    _one(world, '(sum by (instance) (rate(errors_total[5m])) * '
+         'on (instance) group_right rate(http_requests_total[5m]))')
+
+
+def test_pinned_topk(world):
+    """topk/bottomk: per-step partial-sort selection keeps member
+    series with NaN at unselected steps, identically on both arms."""
+    eng = _one(world, 'topk(2, rate(http_requests_total[5m]))')
+    # per step at most 2 non-NaN values across all series
+    rows = list(eng.values())
+    for t in range(len(rows[0])):
+        live = sum(1 for r in rows if not math.isnan(r[t]))
+        assert live <= 2
+    _one(world, 'bottomk(1, avg_over_time(cpu_usage[5m]))')

@@ -49,7 +49,9 @@ def test_counter_metrics_feed_counter_functions_only():
     """Schema discipline by construction: rate/increase/irate never
     see a gauge metric, delta/deriv never see a counter."""
     qs = QueryGen(seed=5).queries(120)
+    from filodb_tpu.promql.gen import DEFAULT_HISTOGRAM
     counters = {m.name for m in DEFAULT_METRICS if m.kind == "counter"}
+    counters.add(DEFAULT_HISTOGRAM.name)      # buckets are counters
     gauges = {m.name for m in DEFAULT_METRICS if m.kind == "gauge"}
     for q in qs:
         for m in re.finditer(
