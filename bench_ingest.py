@@ -5,12 +5,22 @@ Reference harness: jmh/src/main/scala/filodb.jmh/IngestionBenchmark.scala
 (ingestRecords: BinaryRecord containers -> TimeSeriesShard.ingest) and the
 ~5 bytes/sample off-heap sizing rule (conf/timeseries-dev-source.conf).
 
+Also measures the storage-integrity rail's write-path cost: WAL append
+throughput with CRC framing on vs off (group commit opened wide so the
+delta is the checksum+header work, not fsync), reported as
+``wal_append.checksum_overhead_pct``.
+
 Prints ONE JSON line:
   {"metric": "ingest_samples_per_s", "value": ..., "unit": "samples/s",
-   "encode_samples_per_s": ..., "bytes_per_sample": ..., "native": bool}
+   "encode_samples_per_s": ..., "bytes_per_sample": ..., "native": bool,
+   "wal_append": {"framed_samples_per_s": ..., "unframed_samples_per_s":
+   ..., "checksum_overhead_pct": ..., "crc_algo": ...}}
 """
 
 import json
+import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
@@ -18,7 +28,9 @@ import numpy as np
 from filodb_tpu.core.memstore import TimeSeriesShard
 from filodb_tpu.core.record import RecordBuilder
 from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.ingest.stream import LogIngestionStream
 from filodb_tpu.memory import nibblepack as nbp
+from filodb_tpu.store import integrity
 
 S = 200            # series
 N = 720            # samples/series (2h at 10s)
@@ -79,6 +91,8 @@ def measure():
             enc_bytes += sum(len(v) for v in ch.vectors)
             enc_rows += ch.num_rows
 
+    wal = _measure_wal_append(conts, total)
+
     out = {
         "metric": "ingest_samples_per_s",
         "value": round(total / t_ingest, 1),
@@ -87,8 +101,42 @@ def measure():
         "bytes_per_sample": round(enc_bytes / max(enc_rows, 1), 2),
         "samples": total,
         "native_codec": nbp._native is not None,
+        "wal_append": wal,
     }
     return out
+
+
+def _measure_wal_append(conts, total):
+    """WAL append throughput, CRC framing on vs off. Group commit is
+    opened wide (one fsync at close) so the measured delta is the
+    integrity rail's CPU cost — CRC + 12-byte header per record — not
+    disk sync latency."""
+    rates = {}
+    for framed in (True, False):
+        root = tempfile.mkdtemp(prefix="bench-wal-")
+        try:
+            s = LogIngestionStream(
+                os.path.join(root, "stream.log"), DEFAULT_SCHEMAS,
+                group_commit_s=3600.0, group_commit_bytes=1 << 40,
+                integrity_frames=framed)
+            for c in conts:            # warm: file + page cache + index
+                s.append(c)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                for c in conts:
+                    s.append(c)
+            dt = time.perf_counter() - t0
+            s.close()
+            rates[framed] = 3 * total / dt
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    overhead = (rates[False] - rates[True]) / rates[False] * 100.0
+    return {
+        "framed_samples_per_s": round(rates[True], 1),
+        "unframed_samples_per_s": round(rates[False], 1),
+        "checksum_overhead_pct": round(overhead, 2),
+        "crc_algo": integrity.CRC_ALGO,
+    }
 
 
 def main():

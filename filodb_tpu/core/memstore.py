@@ -572,10 +572,47 @@ class TimeSeriesShard:
         # moving the watermark (the entrant's LAST may sit above it),
         # so the results cache invalidates on any epoch change.
         self.ingest_backfill_epoch = 0
+        # storage-integrity state: how many corrupt records the durable
+        # tier quarantined for this shard, and whether that loss tripped
+        # the integrity-max-quarantined-records knob (the shard then
+        # degrades to read-only — serving silently-partial data is the
+        # one thing the integrity rail must never do). Written by the
+        # single ingest thread, read racily by HTTP health threads,
+        # same idiom as the watermark above.
+        self.integrity_quarantined_records = 0
+        self.integrity_read_only = False
         # serializes ODP page-ins (queries arrive from concurrent HTTP
         # threads; page-in rebinds part.chunks — everything else on the
         # read path sees immutable snapshots and needs no lock)
         self._odp_lock = threading.Lock()
+
+    def update_integrity(self, stream_quarantined: int,
+                         max_allowed: int) -> bool:
+        """Refresh the shard's quarantine count (WAL + ColumnStore) and
+        degrade to read-only when it exceeds ``max_allowed``. Returns
+        the read-only state. Called from the ingest thread after reads
+        and BEFORE applying a batch, so no records land after the knob
+        trips."""
+        total = int(stream_quarantined)
+        cs = self.column_store
+        if cs is not None and hasattr(cs, "quarantined_records"):
+            total += cs.quarantined_records(self.ref.dataset,
+                                            self.shard_num)
+        self.integrity_quarantined_records = total
+        if total > max_allowed and not self.integrity_read_only:
+            self.integrity_read_only = True
+            from filodb_tpu.obs import events as obs_events
+            from filodb_tpu.obs import metrics as obs_metrics
+            obs_metrics.GLOBAL_REGISTRY.gauge(
+                "filodb_shard_integrity_read_only",
+                "1 while the shard is degraded to read-only because "
+                "quarantined-record loss exceeded the integrity knob"
+            ).set(1.0, dataset=self.ref.dataset,
+                  shard=str(self.shard_num))
+            obs_events.emit("integrity-read-only",
+                            dataset=self.ref.dataset, shard=self.shard_num,
+                            quarantined=total, max_allowed=max_allowed)
+        return self.integrity_read_only
 
     # -- ingest path ------------------------------------------------------
     def get_or_create_partition(self, part_key: PartKey, first_ts: int,

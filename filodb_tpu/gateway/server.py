@@ -21,6 +21,8 @@ import threading
 from typing import Dict, List, Optional
 
 from filodb_tpu.core.record import RecordBuilder, ingestion_shard
+from filodb_tpu.ingest import health as ingest_health
+from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.core.record import PartKey
 from filodb_tpu.core.schemas import PartitionSchema, Schemas
@@ -28,8 +30,14 @@ from filodb_tpu.gateway.influx import input_records, parse_line
 from filodb_tpu.ingest.stream import IngestionStream
 
 
+@guarded_by("_stats_lock", "lines_ingested", "lines_rejected",
+            "batches_dropped")
 class GatewayServer:
-    """TCP ingest edge, one instance per gateway process."""
+    """TCP ingest edge, one instance per gateway process.
+
+    Line/drop counters ride ``_stats_lock``: producer threads (one per
+    TCP connection) and the HTTP ingest edge (``/api/v1/ingest/influx``
+    handler threads) both route lines through this object."""
 
     def __init__(self, streams: Dict[int, IngestionStream], schemas: Schemas,
                  num_shards: int, spread: int = 1, port: int = 0,
@@ -46,8 +54,13 @@ class GatewayServer:
         self.batch_lines = batch_lines
         self.ws, self.ns = ws, ns
         self.part_schema = PartitionSchema()
+        self._stats_lock = threading.Lock()
         self.lines_ingested = 0
         self.lines_rejected = 0
+        # batches dropped while ingest is degraded to read-only (the
+        # fire-and-forget TCP edge has no backpressure channel — counted
+        # loss beats a crashed producer thread; HTTP ingest gets a 503)
+        self.batches_dropped = 0
         gateway = self
 
         class Handler(socketserver.StreamRequestHandler):
@@ -84,7 +97,8 @@ class GatewayServer:
             rec = parse_line(line)
             samples = input_records(rec, self.ws, self.ns)
         except ValueError:
-            self.lines_rejected += 1
+            with self._stats_lock:
+                self.lines_rejected += 1
             return False
         for schema_name, labels, ts, values in samples:
             schema = self.schemas.by_name(schema_name)
@@ -99,18 +113,52 @@ class GatewayServer:
                                     self.num_shards)
             b = builders.setdefault(shard, RecordBuilder(self.schemas))
             b.add_sample(schema_name, labels, ts, *values)
-        self.lines_ingested += 1
+        with self._stats_lock:
+            self.lines_ingested += 1
         return True
 
-    def _publish(self, builders: Dict[int, RecordBuilder]) -> None:
+    def _publish(self, builders: Dict[int, RecordBuilder],
+                 raise_on_error: bool = False) -> None:
         """Flush per-shard builders into their streams (KafkaContainerSink).
-        """
+
+        Write-path out-of-space degrades instead of crashing the
+        producer thread: the process flips to ingest-read-only
+        (ingest/health.py), and while degraded this edge DROPS batches
+        (counted) except for the rate-limited probe write that detects
+        recovery. ``raise_on_error=True`` (the HTTP ingest edge) raises
+        :class:`~filodb_tpu.ingest.health.IngestReadOnly` instead so
+        the caller can answer 503 + Retry-After."""
+        health = ingest_health.GLOBAL
+        if health.read_only() and not health.should_probe():
+            # containers() drains the builders — the batch is lost
+            # either way (dropped here, or retried wholesale by the
+            # HTTP caller after its 503)
+            dropped = sum(len(b.containers()) for b in builders.values())
+            if dropped:
+                with self._stats_lock:
+                    self.batches_dropped += 1
+            if raise_on_error:
+                raise health.reject()
+            return
+        wrote = False
         for shard, b in builders.items():
             stream = self.streams.get(shard)
             if stream is None:
                 continue
             for cont in b.containers():
-                stream.append(cont)
+                try:
+                    stream.append(cont)
+                    wrote = True
+                except OSError as e:
+                    if health.note_write_error(e, "gateway publish"):
+                        with self._stats_lock:
+                            self.batches_dropped += 1
+                        if raise_on_error:
+                            raise health.reject() from e
+                        return
+                    raise
+        if wrote:
+            health.note_write_ok()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "GatewayServer":

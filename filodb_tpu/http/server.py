@@ -25,8 +25,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from filodb_tpu.http import prom_json
+from filodb_tpu.ingest import health as ingest_health
 from filodb_tpu.lint.caches import publishes
 from filodb_tpu.lint.threads import thread_root
+from filodb_tpu.obs import events as obs_events
 from filodb_tpu.obs import devprof as obs_devprof
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
@@ -197,6 +199,11 @@ class FiloHttpServer:
         # budget goes straight to 429)
         self.qos_degrade_max_steps = int(qos_degrade_max_steps)
         self.qos_shed_degraded = bool(qos_shed_degraded)
+        # set by the standalone server on the worker that owns the
+        # gateway: the GatewayServer behind /api/v1/ingest/influx (the
+        # remote-ingest edge with real backpressure — 503 + Retry-After
+        # while ingest is degraded to read-only)
+        self.gateway = None
         # set by the standalone server: TenantMetering (per-tenant
         # cardinality gauges; also the cost estimator's fan-out
         # cardinality view via make_planner)
@@ -427,6 +434,11 @@ class FiloHttpServer:
             # back off and resubmit as-is.
             code, payload = 429, prom_json.error(str(e), "throttled")
             retry_after_s = e.retry_after_s
+        except ingest_health.IngestReadOnly as e:
+            # the ingest edge while write-path out-of-space degradation
+            # is active: recoverable — resubmit after space is freed
+            code, payload = 503, prom_json.error(str(e), "read_only")
+            retry_after_s = e.retry_after_s
         except QueryLimitError as e:
             code, payload = 422, prom_json.error(str(e), "query_limit")
         except DeadlineExceeded as e:
@@ -492,10 +504,28 @@ class FiloHttpServer:
                         s, "ingest_backfill_epoch", 0) or 0)
             down = (sorted(self.detector.down_peers())
                     if self.detector is not None else [])
+            # storage-integrity flags: per-shard quarantined-record
+            # counts and which shards degraded to read-only, plus the
+            # process-wide ENOSPC ingest-read-only state
+            quarantined: Dict[str, int] = {}
+            integrity_ro: List[str] = []
+            for lst in self.shards_by_dataset.values():
+                for i, s in enumerate(lst):
+                    n = getattr(s, "shard_num", i)
+                    q = int(getattr(
+                        s, "integrity_quarantined_records", 0) or 0)
+                    if q:
+                        quarantined[str(n)] = q
+                    if getattr(s, "integrity_read_only", False):
+                        integrity_ro.append(str(n))
             body = {"status": "healthy", "shards": shards_adv,
                     "down_peers": down,
                     "watermarks": watermarks,
-                    "backfill_epochs": epochs}
+                    "backfill_epochs": epochs,
+                    "ingest_read_only":
+                        ingest_health.GLOBAL.read_only(),
+                    "integrity": {"quarantined": quarantined,
+                                  "read_only_shards": integrity_ro}}
             if self.shard_mapper is not None \
                     and hasattr(self.shard_mapper, "topology_epoch"):
                 body["topo_epoch"] = self.shard_mapper.topology_epoch
@@ -528,6 +558,17 @@ class FiloHttpServer:
             from filodb_tpu.lint.threads import thread_inventory
             return 200, {"status": "success",
                          "data": thread_inventory()}
+        if path == "/debug/events":
+            # the structured operational journal (obs/events.py):
+            # corruption detections, quarantine actions, integrity and
+            # ingest-read-only transitions — newest first
+            limit = int(self._param(qs, "limit", "100") or 100)
+            kind = self._param(qs, "kind", None)
+            return 200, {"status": "success",
+                         "data": obs_events.snapshot(limit=limit,
+                                                     kind=kind)}
+        if path == "/api/v1/ingest/influx":
+            return self._ingest_influx(body_raw)
         if path == "/debug/slow_queries":
             limit = int(self._param(qs, "limit", "50") or 50)
             return 200, {"status": "success",
@@ -1284,6 +1325,40 @@ class FiloHttpServer:
     def _param(qs, name, default=None):
         v = qs.get(name)
         return v[0] if v else default
+
+    def _ingest_influx(self, body_raw: bytes):
+        """Remote ingest edge: newline-delimited influx lines in the
+        POST body, routed through the gateway's builders into the
+        per-shard WALs. Unlike the fire-and-forget TCP gateway this
+        endpoint has an ack channel: 200 means every line's container
+        was appended (fsync'd when group commit is off — the soak
+        test's acked-sample ledger trusts exactly this); while ingest
+        is degraded to read-only it answers 503 + Retry-After."""
+        gw = self.gateway
+        if gw is None:
+            return 404, prom_json.error(
+                "no gateway on this worker (the gateway rides exactly "
+                "one worker per host)", "not_found")
+        health = ingest_health.GLOBAL
+        if health.read_only() and not health.probe_due():
+            # fast 503 without touching the disk; the rate-limited
+            # probe slot is claimed inside _publish when due
+            raise health.reject()
+        from filodb_tpu.core.record import RecordBuilder
+        builders: Dict[int, RecordBuilder] = {}
+        accepted = rejected = 0
+        for raw in body_raw.splitlines():
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line or line.startswith("#"):
+                continue
+            if gw._route_line(line, builders):
+                accepted += 1
+            else:
+                rejected += 1
+        gw._publish(builders, raise_on_error=True)
+        return 200, {"status": "success",
+                     "data": {"accepted": accepted,
+                              "rejected": rejected}}
 
     @staticmethod
     def _parse_duration_s(raw: Optional[str], default_s: float) -> float:

@@ -1,9 +1,13 @@
 """Streaming ingestion: stream sources, per-shard drivers, recovery.
 
 (Reference packages: kafka/ + coordinator IngestionActor/IngestionStream.)
+
+The driver imports are lazy (PEP 562): ``IngestionDriver`` pulls in the
+memstore and therefore jax, which offline tools walking durable files
+(``python -m filodb_tpu.fsck``) must not pay for just to reach the
+stream codec.
 """
 
-from filodb_tpu.ingest.driver import IngestionDriver, start_ingestion
 from filodb_tpu.ingest.stream import (IngestionStream, LogIngestionStream,
                                       MemoryIngestionStream, SomeData,
                                       decode_container, encode_container)
@@ -13,3 +17,10 @@ __all__ = [
     "LogIngestionStream", "MemoryIngestionStream", "SomeData",
     "decode_container", "encode_container",
 ]
+
+
+def __getattr__(name):
+    if name in ("IngestionDriver", "start_ingestion"):
+        from filodb_tpu.ingest import driver
+        return getattr(driver, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -31,6 +31,7 @@ import time
 from typing import Callable, List, Optional
 
 from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.ingest import health as ingest_health
 from filodb_tpu.ingest.stream import IngestionStream
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import metrics as obs_metrics
@@ -52,7 +53,8 @@ class IngestionDriver:
                  on_event: Optional[Callable] = None,
                  max_resident_samples: int = 0,
                  ingest_batch_records: int = 64,
-                 max_decode_cache_bytes: int = 0):
+                 max_decode_cache_bytes: int = 0,
+                 max_quarantined_records: int = 0):
         self.shard = shard
         self.stream = stream
         self.mapper = mapper
@@ -69,6 +71,10 @@ class IngestionDriver:
         # decode/merge-cache byte budget (0 = unbounded): trimmed on the
         # flush path via TimeSeriesShard.trim_decode_caches
         self.max_decode_cache_bytes = int(max_decode_cache_bytes)
+        # integrity knob (integrity-max-quarantined-records): tolerated
+        # quarantined-record loss before the shard degrades to
+        # read-only. 0 = any quarantined record trips it.
+        self.max_quarantined_records = int(max_quarantined_records)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._next_group = 0
@@ -133,17 +139,40 @@ class IngestionDriver:
         while self.next_offset < end and not self._stop.is_set():
             if not self._ingest_available(
                     limit=min(self.ingest_batch_records,
-                              end - self.next_offset)):
+                              end - self.next_offset),
+                    recovering=True):
                 break                            # stream shrank (shouldn't)
             done = self.next_offset - start
             pct = int(100 * done / max(1, end - start))
             self._set_status(ShardStatus.RECOVERY, min(pct, 99))
 
-    def _ingest_available(self, limit: Optional[int] = None) -> bool:
-        """Poll + ingest one batch; returns True if anything was read."""
+    def _ingest_available(self, limit: Optional[int] = None,
+                          recovering: bool = False) -> bool:
+        """Poll + ingest one batch; returns True if anything was read.
+
+        ``recovering=True`` (the startup replay) applies batches even
+        once the quarantine knob trips: every record the scan kept is
+        checksum-verified acked data, and dropping it would turn one
+        corrupt record into a whole-shard truncation. The read-only
+        flag (and its metric/event) still raises immediately — it gates
+        NEW post-recovery ingest only."""
+        if self.shard.integrity_read_only and not recovering:
+            return False
         if limit is None:
             limit = self.ingest_batch_records
         batch = self.stream.read(self.next_offset, max_records=limit)
+        # the read may have quarantined corrupt records: refresh the
+        # shard's integrity state BEFORE applying the batch, so nothing
+        # new lands once loss exceeds the knob
+        q = getattr(self.stream, "quarantined_records", None)
+        if q is not None or self.shard.column_store is not None:
+            # read-only keeps the mapper status ACTIVE: the shard still
+            # SERVES queries (flagged in health + metrics + events), it
+            # just stops applying new records
+            if self.shard.update_integrity(q() if q is not None else 0,
+                                           self.max_quarantined_records) \
+                    and not recovering:
+                return False
         if not batch:
             return False
         # chaos fault point: a failing stream consumer (the Kafka-poll
@@ -173,8 +202,19 @@ class IngestionDriver:
         # chaos fault point: a failing flush (ColumnStore write error)
         chaos.fire("ingest.flush", shard=self.shard.shard_num,
                    group=group)
-        with obs_metrics.timed("filodb_flush_seconds", _FLUSH_HELP):
-            self.shard.flush_group(group, offset=self.next_offset - 1)
+        try:
+            with obs_metrics.timed("filodb_flush_seconds", _FLUSH_HELP):
+                self.shard.flush_group(group, offset=self.next_offset - 1)
+        except OSError as e:
+            if ingest_health.GLOBAL.note_write_error(
+                    e, f"flush shard={self.shard.shard_num} group={group}"):
+                # out-of-space: the flush retries on its normal cadence
+                # (the batch stays resident; the checkpoint did not
+                # advance) — NOT a driver-thread-killing error
+                self._last_flush_t = time.monotonic()
+                return
+            raise
+        ingest_health.GLOBAL.note_write_ok()
         if self.max_resident_samples:
             self.shard.ensure_headroom(self.max_resident_samples)
         if self.max_decode_cache_bytes:

@@ -38,6 +38,8 @@ from filodb_tpu.core.schemas import ColumnType, Schemas
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.memory.histogram import _decode_scheme, _encode_scheme
 from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.store import integrity
+from filodb_tpu.testing import chaos
 
 _APPEND_HELP = ("Wall seconds per durable-stream append (encode + "
                 "write + flush + any fsync this append performed)")
@@ -183,10 +185,28 @@ def decode_container(buf: bytes, off: int, schemas: Schemas
     return cont, end
 
 
+def legacy_wal_probe(buf: bytes, off: int) -> int:
+    """Integrity-scanner probe for pre-framing WAL records: total
+    record length when a plausible legacy record starts at ``off``,
+    -1 when one starts but runs past the buffer (torn), 0 otherwise."""
+    if off + _REC_HDR.size > len(buf):
+        return -1 if off + 2 <= len(buf) and \
+            struct.unpack_from("<H", buf, off)[0] == _REC_MAGIC else 0
+    magic, name_len, _, payload_len = _REC_HDR.unpack_from(buf, off)
+    if magic != _REC_MAGIC:
+        return 0
+    if payload_len > integrity.MAX_PAYLOAD:
+        return 0
+    total = _REC_HDR.size + name_len + payload_len
+    return total if off + total <= len(buf) else -1
+
+
 # producer and consumer sides may be different THREADS in one process
 # (embedded gateway + ingest driver): the writer handle, the record
-# position index, and the valid-prefix watermark all ride one lock
-@guarded_by("_lock", "_write_f", "_positions", "_valid_end",
+# index, and the scan watermark all ride one lock
+@guarded_by("_lock", "_write_f", "_records", "_scan_end", "_tail_state",
+            "_tail_off", "_tail_reason", "_tail_reported_off",
+            "_read_bad", "_quarantined_records", "_quarantined_bytes",
             "_last_sync_t", "_unsynced_bytes")
 class LogIngestionStream(IngestionStream):
     """Durable file-backed stream: one append-only framed log per shard —
@@ -210,17 +230,31 @@ class LogIngestionStream(IngestionStream):
 
     def __init__(self, path: str, schemas: Schemas,
                  group_commit_s: float = 0.0,
-                 group_commit_bytes: int = 1 << 20):
+                 group_commit_bytes: int = 1 << 20,
+                 integrity_frames: bool = True):
         self.path = path
         self.schemas = schemas
         self.group_commit_s = float(group_commit_s)
         self.group_commit_bytes = int(group_commit_bytes)
+        # integrity_frames=False writes legacy unframed records — kept
+        # for mixed-version tests and the bench's CRC on/off split
+        self.integrity_frames = bool(integrity_frames)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._write_f = None
         self._lock = threading.Lock()
-        # reader state: byte positions of each complete record
-        self._positions: List[int] = []
-        self._valid_end = 0
+        # reader state: scanner-verified records, the classified-bytes
+        # watermark the next scan resumes from, and the last tail state
+        self._records: List[integrity.ScanRecord] = []
+        self._scan_end = 0
+        self._tail_state = "clean"
+        self._tail_off = 0
+        self._tail_reason = ""
+        self._tail_reported_off = -1
+        # read-time verification strikes per ordinal: first failure
+        # retries from disk (transient), second skips-and-advances
+        self._read_bad: Dict[int, int] = {}
+        self._quarantined_records = 0
+        self._quarantined_bytes = 0
         # group-commit state: when the last fsync happened and how many
         # bytes are flushed-but-unsynced since
         self._last_sync_t = 0.0
@@ -233,26 +267,47 @@ class LogIngestionStream(IngestionStream):
         """Publish one container; returns its offset (ordinal).  One writer
         per shard log (the shard<->partition ownership invariant); on
         takeover, a torn tail left by a crashed writer is truncated so the
-        new append lands on a record boundary."""
+        new append lands on a record boundary (a CORRUPT tail — bad bytes,
+        not just incomplete — is quarantined before the truncate)."""
         import time as _time
         t0 = _time.perf_counter()
-        data = encode_container(container)
+        payload = encode_container(container)
+        data = integrity.encode_frame(payload) if self.integrity_frames \
+            else payload
         with self._lock:
             if self._write_f is None:
                 self._refresh_locked()
                 if os.path.exists(self.path) and \
-                        os.path.getsize(self.path) > self._valid_end:
-                    os.truncate(self.path, self._valid_end)
+                        os.path.getsize(self.path) > self._scan_end:
+                    if self._tail_state == "corrupt":
+                        self._quarantine_tail_locked()
+                    os.truncate(self.path, self._scan_end)
+                    self._tail_state = "clean"
                 self._write_f = open(self.path, "ab")
-            off = len(self._positions)
-            self._write_f.write(data)
-            self._write_f.flush()
+            off = len(self._records)
+            try:
+                chaos.write("wal.append", self._write_f, data,
+                            path=self.path, nbytes=len(data))
+                self._write_f.flush()
+            except OSError:
+                # the buffer may hold a torn prefix: flush it out and
+                # drop the handle so the next append takes over (and
+                # truncates the torn tail) instead of appending after it
+                try:
+                    self._write_f.close()
+                except OSError:
+                    pass
+                self._write_f = None
+                raise
             self._unsynced_bytes += len(data)
             if fsync:
                 # graftlint: disable=lock-blocking-reachable (single-writer WAL: the lock IS the producer/consumer serialization; group commit bounds the fsync window)
                 self._maybe_fsync_locked()
-            self._positions.append(self._valid_end)
-            self._valid_end += len(data)
+            hdr = integrity.FRAME_HDR.size if self.integrity_frames else 0
+            self._records.append(integrity.ScanRecord(
+                self._scan_end, len(data), self._scan_end + hdr,
+                len(payload), self.integrity_frames))
+            self._scan_end += len(data)
             self.appends += 1
         obs_metrics.observe("filodb_ingest_append_seconds", _APPEND_HELP,
                             _time.perf_counter() - t0,
@@ -272,6 +327,7 @@ class LogIngestionStream(IngestionStream):
                     and self._unsynced_bytes < self.group_commit_bytes):
                 return
         t0 = _time.perf_counter()
+        chaos.fire("wal.fsync", path=self.path)
         os.fsync(self._write_f.fileno())
         obs_metrics.observe("filodb_ingest_fsync_seconds", _FSYNC_HELP,
                             _time.perf_counter() - t0,
@@ -289,29 +345,69 @@ class LogIngestionStream(IngestionStream):
 
     # -- consumer side ----------------------------------------------------
     def _refresh_locked(self) -> int:
-        """Extend the position index over newly appended bytes; returns the
-        current record count."""
+        """Extend the record index over newly appended bytes via the
+        integrity scanner; returns the current record count. Corrupt
+        regions are quarantined and SKIPPED (replay resumes at the next
+        verified boundary) — the pre-integrity behavior of silently
+        halting indexing forever is gone."""
         if not os.path.exists(self.path):
             return 0
         size = os.path.getsize(self.path)
-        if size <= self._valid_end:
-            return len(self._positions)
+        if size <= self._scan_end:
+            return len(self._records)
         with open(self.path, "rb") as f:
-            f.seek(self._valid_end)
-            buf = f.read(size - self._valid_end)
-        p = 0
-        while p + _REC_HDR.size <= len(buf):
-            magic, name_len, _, payload_len = _REC_HDR.unpack_from(buf, p)
-            if magic != _REC_MAGIC:
-                # corrupt bytes mid-log: stop indexing here permanently
-                break
-            end = p + _REC_HDR.size + name_len + payload_len
-            if end > len(buf):
-                break                      # torn tail: writer mid-append
-            self._positions.append(self._valid_end + p)
-            p = end
-        self._valid_end += p
-        return len(self._positions)
+            f.seek(self._scan_end)
+            buf = f.read(size - self._scan_end)
+        buf = chaos.filter_read("wal.read", buf, path=self.path,
+                                offset=self._scan_end)
+        res = integrity.scan_buffer(buf, probe=legacy_wal_probe,
+                                    base=self._scan_end)
+        for reg in res.corrupt:
+            integrity.quarantine(
+                self.path, "wal", reg.offset,
+                buf[reg.offset - self._scan_end:
+                    reg.offset - self._scan_end + reg.length],
+                reg.reason)
+            self._quarantined_records += 1
+            self._quarantined_bytes += reg.length
+        self._records.extend(res.records)
+        self._scan_end += res.consumed
+        self._tail_state = res.tail_state
+        self._tail_off = res.tail_off
+        self._tail_reason = res.tail_reason
+        if (res.tail_state == "corrupt"
+                and res.tail_off != self._tail_reported_off):
+            # bad bytes with no resync point yet: more appends may
+            # reveal one (then the region quarantines above), takeover
+            # quarantines + truncates, fsck repairs — but say so NOW
+            self._tail_reported_off = res.tail_off
+            integrity.record_corruption(
+                "wal", self.path, res.tail_off,
+                size - res.tail_off, res.tail_reason, action="pending")
+        return len(self._records)
+
+    def _quarantine_tail_locked(self) -> None:
+        """Copy a corrupt tail to the sidecar before takeover truncates
+        it (truncation must never destroy the only copy of bad bytes)."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._scan_end)
+                tail = f.read()
+        except OSError:
+            return
+        if tail:
+            integrity.quarantine(self.path, "wal", self._scan_end, tail,
+                                 self._tail_reason or "corrupt tail",
+                                 action="quarantined-truncated")
+            self._quarantined_records += 1
+            self._quarantined_bytes += len(tail)
+
+    def _empty_container(self) -> RecordContainer:
+        """Zero-row placeholder emitted for a record whose bytes failed
+        read-time verification twice: replay ADVANCES past the damage
+        (the bytes are already quarantined) instead of stalling."""
+        schema = next(iter(self.schemas.schemas.values()))
+        return RecordContainer(schema)
 
     def read(self, from_offset: int, max_records: int = 64
              ) -> List[SomeData]:
@@ -321,22 +417,74 @@ class LogIngestionStream(IngestionStream):
             hi = min(n, lo + max_records)
             if lo >= hi:
                 return []
-            positions = self._positions[lo:hi]
-            valid_end = self._valid_end
-        out: List[SomeData] = []
+            records = self._records[lo:hi]
+        base = records[0].offset
+        end = records[-1].offset + records[-1].length
         with open(self.path, "rb") as f:
-            f.seek(positions[0])
-            buf = f.read(valid_end - positions[0])
-        for i, pos in enumerate(positions):
-            cont, _ = decode_container(buf, pos - positions[0], self.schemas)
-            if cont is None:
-                break
-            out.append(SomeData(cont, lo + i))
+            f.seek(base)
+            buf = f.read(end - base)
+        buf = chaos.filter_read("wal.read", buf, path=self.path,
+                                offset=base)
+        out: List[SomeData] = []
+        for i, rec in enumerate(records):
+            ordinal = lo + i
+            try:
+                if rec.framed:
+                    # read-path verification: the CRC is re-checked on
+                    # every decode, not only at scan time — bit rot
+                    # between scan and read cannot reach a query
+                    payload, _ = integrity.decode_frame(
+                        buf, rec.offset - base)
+                    if payload is None:
+                        break              # torn at buffer end: wait
+                    cont, _ = decode_container(payload, 0, self.schemas)
+                else:
+                    cont, _ = decode_container(buf, rec.offset - base,
+                                               self.schemas)
+                    if cont is None:
+                        break
+            except (integrity.FrameError, ValueError, KeyError,
+                    struct.error) as e:
+                with self._lock:
+                    strikes = self._read_bad.get(ordinal, 0)
+                    self._read_bad[ordinal] = strikes + 1
+                if strikes == 0:
+                    # first failure: stop here and let the next poll
+                    # re-read from disk (a transient flip heals itself)
+                    integrity.record_corruption(
+                        "wal", self.path, rec.offset, rec.length,
+                        f"read-time verification failed: {e}",
+                        action="read-retry")
+                    break
+                # persistent damage: quarantine the bytes, emit an
+                # empty batch at this ordinal so replay advances
+                integrity.quarantine(
+                    self.path, "wal", rec.offset,
+                    buf[rec.offset - base:rec.offset - base + rec.length],
+                    f"read-time verification failed: {e}",
+                    action="skipped")
+                with self._lock:
+                    self._quarantined_records += 1
+                    self._quarantined_bytes += rec.length
+                cont = self._empty_container()
+            out.append(SomeData(cont, ordinal))
         return out
 
     def end_offset(self) -> int:
         with self._lock:
             return self._refresh_locked()
+
+    def quarantined_records(self) -> int:
+        with self._lock:
+            return self._quarantined_records
+
+    def quarantined_bytes(self) -> int:
+        with self._lock:
+            return self._quarantined_bytes
+
+    def tail_state(self) -> str:
+        with self._lock:
+            return self._tail_state
 
     def close(self) -> None:
         with self._lock:

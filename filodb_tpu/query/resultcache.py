@@ -183,6 +183,21 @@ def shards_epoch(shards: Sequence[object]) -> int:
                for s in shards)
 
 
+@event_source("integrity-quarantine")
+def shards_quarantine(shards: Sequence[object]) -> int:
+    """Unresolved quarantined-record count over the engine's local
+    shards (storage-integrity rail, PR 16). A shard whose durable files
+    quarantined records may be missing arbitrary samples — results
+    computed over it are not wrong (the live memstore is intact) but
+    extents CACHED from it could outlive a later repair/replay that
+    restores the quarantined data, serving the lossy view long after
+    the store healed. Any nonzero count makes the scope uncacheable
+    and refuses existing extents until the quarantine is resolved
+    (fsck repair + restart resets the count)."""
+    return sum(int(getattr(s, "integrity_quarantined_records", 0) or 0)
+               for s in shards)
+
+
 def _pow2_spans(spans: List[Tuple[int, int]], start_ms: int,
                 step_ms: int, grid_end: int) -> List[Tuple[int, int]]:
     """Widen uncovered spans to power-of-two step counts by extending
@@ -407,17 +422,18 @@ class RangeSession:
             "misses", "stitches", "churn_recomputes", "bypassed",
             "uncacheable", "stores", "evictions", "degraded_skips",
             "invalidations", "watermark_invalidations",
-            "backfill_invalidations",
+            "backfill_invalidations", "integrity_refused",
             "cached_steps_served", "computed_steps_served",
             "stale_serves")
 # inventory declaration (graftlint cache-invalidation-completeness):
 # topology/schema events PUSH through the plan-cache listener chain to
-# `invalidate`; watermark, backfill-epoch, and dispatch-scope are PULL
-# events — both serving entry points must keep reading their
-# @event_source functions (shards_watermark/watermark_coverage,
-# shards_epoch, dispatch_scope) or the lint gate fails. This is the
-# declaration that would have caught the PR 5 dispatch-scope key miss
-# and the PR 6 watermark-coverage hole at review time.
+# `invalidate`; watermark, backfill-epoch, dispatch-scope, and
+# integrity-quarantine are PULL events — both serving entry points
+# must keep reading their @event_source functions (shards_watermark/
+# watermark_coverage, shards_epoch, dispatch_scope, shards_quarantine)
+# or the lint gate fails. This is the declaration that would have
+# caught the PR 5 dispatch-scope key miss and the PR 6
+# watermark-coverage hole at review time.
 @cache_registry("results",
                 invalidated_by={"topology-epoch": "invalidate",
                                 "schema": "invalidate"},
@@ -425,7 +441,9 @@ class RangeSession:
                               "backfill-epoch": ("begin",
                                                  "stale_serve"),
                               "dispatch-scope": ("begin",
-                                                 "stale_serve")},
+                                                 "stale_serve"),
+                              "integrity-quarantine": ("begin",
+                                                       "stale_serve")},
                 keyed=("dataset", "query-text", "step", "grid-phase",
                        "dispatch-scope"))
 class ResultCache:
@@ -461,6 +479,7 @@ class ResultCache:
         self.invalidations = 0
         self.watermark_invalidations = 0
         self.backfill_invalidations = 0     # epoch-change drops
+        self.integrity_refused = 0  # scope has unresolved quarantine
         self.cached_steps_served = 0
         self.computed_steps_served = 0
         self.stale_serves = 0       # brownout rung: served past horizon
@@ -491,6 +510,15 @@ class ResultCache:
             return mk(self, "uncacheable", [plan], plan, None, dataset,
                       query, start_ms, step_ms, end_ms)
         shards = getattr(engine, "shards", ())
+        if shards_quarantine(shards) > 0:
+            # unresolved quarantine in scope: the durable tier is
+            # missing records — neither serve nor store extents from
+            # this world (the query still runs, uncached)
+            with self._lock:
+                self.integrity_refused += 1
+                self.uncacheable += 1
+            return mk(self, "uncacheable", [plan], plan, None, dataset,
+                      query, start_ms, step_ms, end_ms)
         wm = shards_watermark(shards)
         ep = shards_epoch(shards)
         cov_n = watermark_coverage(shards)
@@ -559,6 +587,12 @@ class ResultCache:
                 or not result_cacheable(plan):
             return None
         shards = getattr(engine, "shards", ())
+        if shards_quarantine(shards) > 0:
+            # stale must never mean LOSSY: a quarantined scope refuses
+            # its extents even on the brownout rung
+            with self._lock:
+                self.integrity_refused += 1
+            return None
         key = range_abstracted_key(dataset, query, step_ms) \
             + (int(start_ms) % int(step_ms), dispatch_scope(engine))
         ext = self._lookup(key, shards_watermark(shards),

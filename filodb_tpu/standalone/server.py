@@ -153,6 +153,12 @@ DEFAULTS = {
     # 0 = strict fsync-per-append. The durability window is bounded by
     # this knob; stream close / checkpoint sync() force the tail out.
     "stream-group-commit-ms": 5.0,
+    # storage-integrity knob: quarantined-record loss a shard tolerates
+    # before degrading to read-only (queries keep serving, flagged in
+    # /__health "integrity"). 0 = ANY quarantined record trips it — the
+    # zero-silent-loss default; raise it only when replay-through-
+    # damage is preferred over read-only (fsck can repair offline).
+    "integrity-max-quarantined-records": 0,
     # admission control: query endpoints admit at most this many
     # in-flight evaluations (excess parks on a semaphore); 0 = off.
     # The wait is BOUNDED: a slot that does not free within
@@ -878,6 +884,11 @@ class FiloServer:
                 spread=int(self.config.get("default-spread", 1)),
                 spread_provider=self.spread_provider,
                 port=int(self.config["gateway-port"])).start()
+            if self.http is not None:
+                # remote-ingest edge with backpressure: the HTTP
+                # /api/v1/ingest/influx route publishes through the
+                # same builders/streams as the TCP gateway
+                self.http.gateway = self.gateway
 
     # -- reserved internal datasets (selfmon + rules write-back) ----------
     def _setup_internal_dataset(self, dataset: str, subdir: str):
@@ -1026,7 +1037,9 @@ class FiloServer:
             ingest_batch_records=int(
                 self.config.get("ingest-batch-records", 64)),
             max_decode_cache_bytes=int(float(
-                self.config.get("decode-cache-mb", 0)) * (1 << 20)))
+                self.config.get("decode-cache-mb", 0)) * (1 << 20)),
+            max_quarantined_records=int(self.config.get(
+                "integrity-max-quarantined-records", 0)))
 
     def _restart_driver(self, shard: int) -> None:
         """Handoff rollback: the successor never went ACTIVE — resume

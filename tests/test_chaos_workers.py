@@ -325,3 +325,166 @@ def test_kill9_worker_mid_load_zero_failed_queries(tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=30)
+
+
+# -- crash-recovery soak (storage-integrity rail) --------------------------
+
+def _post_lines(port, lines, timeout=30):
+    body = ("\n".join(lines) + "\n").encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/v1/ingest/influx", data=body,
+        headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_kill9_soak_zero_loss_of_acked_samples(tmp_path):
+    """Crash-recovery soak: kill -9 the worker under sustained HTTP
+    ingest for >= 5 cycles. With group commit OFF a 200 from
+    /api/v1/ingest/influx means the batch was appended AND fsync'd —
+    so after the dust settles, every acked sample must be present in
+    the WALs. Lost un-acked samples are fine; lost ACKED samples are
+    the bug this soak exists to catch."""
+    n_shards = 2
+    cfg = {
+        "num-shards": n_shards, "port": _free_port(),
+        "serving-workers": 1,            # the gateway rides worker 0
+        "supervisor-port": 0,
+        "gateway-port": 0,
+        "run-dir": str(tmp_path / "run"),
+        "data-dir": str(tmp_path / "data"),
+        "stream-dir": str(tmp_path / "streams"),
+        "stream-group-commit-ms": 0,     # fsync per append: 200 == durable
+        "flush-interval-s": 0.5,
+        "query-sample-limit": 0, "query-series-limit": 0,
+        "grpc-port": None,
+        "monitor-interval-s": 0.1,
+        "restart-backoff-s": 0.2,
+    }
+    cfg_path = tmp_path / "soak.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone.supervisor",
+         "--config", str(cfg_path)],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    acked = []                  # values whose batch got a 200
+    seq = [0]
+
+    def _lines(n=4):
+        out = []
+        for _ in range(n):
+            seq[0] += 1
+            ts_ns = (T0 + seq[0]) * 1_000_000_000
+            out.append(f"soak_heap,instance=i{seq[0] % 4} "
+                       f"gauge={float(seq[0])} {ts_ns}")
+        return out
+
+    try:
+        buf = b""
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline and b"\n" not in buf:
+            r, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if r:
+                ch = proc.stdout.read1(4096)
+                if not ch:
+                    raise RuntimeError("supervisor died during startup")
+                buf += ch
+        line = json.loads(buf.split(b"\n", 1)[0])
+        pub, sup_port = line["port"], line["supervisor_port"]
+
+        def _ready():
+            _, hb = _get(sup_port, "/__health")
+            w = json.loads(hb)["workers"]["0"]
+            return (w["alive"] and w["ready"]), w
+        _poll(_ready, timeout=180)
+
+        for cycle in range(5):
+            # sustained ingest: acked batches join the ledger; batches
+            # that die with the worker (connection error / non-200) are
+            # allowed to be lost
+            sent_this_cycle = 0
+            deadline = time.monotonic() + 20
+            while sent_this_cycle < 6 and time.monotonic() < deadline:
+                batch = _lines()
+                try:
+                    status, body = _post_lines(pub, batch)
+                except (OSError, ValueError):
+                    continue
+                if status == 200 \
+                        and body["data"]["rejected"] == 0:
+                    acked.extend(batch)
+                    sent_this_cycle += 1
+            assert sent_this_cycle >= 1, f"cycle {cycle}: no acks"
+
+            _, hb = _get(sup_port, "/__health")
+            w = json.loads(hb)["workers"]["0"]
+            victim_pid, restarts0 = w["pid"], w["restarts"]
+            # fire one more batch and kill while it may be in flight
+            killer_batch = _lines()
+            try:
+                status, body = _post_lines(pub, killer_batch, timeout=5)
+                if status == 200 and body["data"]["rejected"] == 0:
+                    acked.extend(killer_batch)
+            except (OSError, ValueError):
+                pass
+            os.kill(victim_pid, signal.SIGKILL)
+
+            def _respawned():
+                _, hb2 = _get(sup_port, "/__health")
+                w2 = json.loads(hb2)["workers"]["0"]
+                return (w2["restarts"] > restarts0 and w2["alive"]
+                        and w2["ready"] and w2["pid"] != victim_pid), w2
+            _poll(_respawned, timeout=180)
+
+        # post-recovery ingest still works (replay + takeover healed
+        # any torn tail the kills left behind)
+        final = _lines()
+
+        def _final_ack():
+            status, body = _post_lines(pub, final)
+            return (status == 200
+                    and body["data"]["rejected"] == 0), body
+        _poll(_final_ack, timeout=60)
+        acked.extend(final)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # -- audit: every acked value must be durable in some WAL ----------
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    from filodb_tpu.ingest import LogIngestionStream
+    durable = set()
+    for sh in range(n_shards):
+        path = os.path.join(str(tmp_path / "streams"), f"shard={sh}",
+                            "stream.log")
+        if not os.path.exists(path):
+            continue
+        s = LogIngestionStream(path, DEFAULT_SCHEMAS)
+        off = 0
+        while True:
+            batch = s.read(off, 256)
+            if not batch:
+                break
+            for sd in batch:
+                cont = sd.container
+                if cont.schema.name == "gauge":
+                    durable.update(cont.columns[0])
+                off = sd.offset + 1
+        assert s.quarantined_records() == 0, \
+            "kill -9 must tear tails, never corrupt acked records"
+        s.close()
+
+    acked_vals = {float(ln.split("gauge=")[1].split()[0])
+                  for ln in acked}
+    missing = acked_vals - durable
+    assert not missing, (f"{len(missing)} fsync-acked samples lost "
+                         f"across 5 kill -9 cycles: "
+                         f"{sorted(missing)[:10]}")
+    assert len(acked_vals) >= 5 * 4 * 4   # real coverage, not vacuous

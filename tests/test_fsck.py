@@ -1,0 +1,157 @@
+"""Offline fsck smoke tests against a fixture data dir holding a torn
+tail, a bit-flipped frame, and a clean file — plus ``--repair`` and the
+mixed-version format-split report (``python -m filodb_tpu.fsck``)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from filodb_tpu import fsck
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.ingest import LogIngestionStream
+from filodb_tpu.store import FlatFileColumnStore, integrity
+
+REF = DatasetRef("timeseries")
+T0 = 1_600_000_000
+
+
+def _write_wal(path, n=5, framed=True):
+    s = LogIngestionStream(path, DEFAULT_SCHEMAS, integrity_frames=framed)
+    for i in range(n):
+        b = RecordBuilder(DEFAULT_SCHEMAS)
+        b.add_sample("gauge", {"_metric_": "m", "_ws_": "demo",
+                               "_ns_": "App-0", "instance": f"i{i}"},
+                     (T0 + i) * 1000, float(i))
+        for c in b.containers():
+            s.append(c)
+    recs = list(s._records)
+    s.close()
+    return recs
+
+
+def _flip(path, pos, mask=0x01):
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ mask]))
+
+
+@pytest.fixture
+def fixture_dir(tmp_path):
+    """shard-0: bit-flipped WAL frame; shard-1: torn WAL tail;
+    shard-2: clean WAL; plus a flushed column-store shard dir with a
+    corrupted checkpoint."""
+    d0 = tmp_path / "shard-0"; d0.mkdir()
+    recs = _write_wal(str(d0 / "stream.log"))
+    victim = recs[2]
+    _flip(str(d0 / "stream.log"),
+          victim.payload_off + victim.payload_len // 2)
+
+    d1 = tmp_path / "shard-1"; d1.mkdir()
+    _write_wal(str(d1 / "stream.log"))
+    with open(d1 / "stream.log", "ab") as f:
+        f.write(integrity.encode_frame(b"y" * 64)[:17])
+
+    d2 = tmp_path / "shard-2"; d2.mkdir()
+    _write_wal(str(d2 / "stream.log"))
+
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=32, column_store=cs)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for t in range(64):
+        b.add_sample("prom-counter",
+                     {"_metric_": "c_total", "_ws_": "demo",
+                      "_ns_": "App-0", "instance": "i0"},
+                     (T0 + t * 10) * 1000, float(t))
+    for c in b.containers():
+        shard.ingest(c, 3)
+    shard.flush_all(offset=3)
+    cs.close()
+    ckpt = cs._ckpt_path("timeseries", 0)
+    _flip(ckpt, os.path.getsize(ckpt) // 2)
+    return tmp_path
+
+
+def test_fsck_reports_findings(fixture_dir):
+    report = fsck.check_dir(str(fixture_dir))
+    by_path = {f["path"]: f for f in report["files"]}
+    s = report["summary"]
+    assert s["files_checked"] >= 6      # 3 WALs + chunks + pk + ckpt
+    assert s["files_with_findings"] == 3
+    flipped = by_path[str(fixture_dir / "shard-0" / "stream.log")]
+    assert flipped["corrupt_regions"] and not flipped["clean"]
+    assert flipped["records"]["framed"] == 4
+    torn = by_path[str(fixture_dir / "shard-1" / "stream.log")]
+    assert torn["tail"]["state"] == "torn"
+    clean = by_path[str(fixture_dir / "shard-2" / "stream.log")]
+    assert clean["clean"] and clean["records"]["framed"] == 5
+    ckpts = [f for f in report["files"] if f["kind"] == "checkpoint"]
+    assert ckpts and not ckpts[0]["clean"]
+    # the flushed chunk/partkey logs are untouched and verify clean
+    for kind in ("chunklog", "partkeys"):
+        assert all(f["clean"] for f in report["files"]
+                   if f["kind"] == kind)
+
+
+def test_fsck_repair_then_clean(fixture_dir):
+    report = fsck.check_dir(str(fixture_dir), repair=True)
+    assert all(f.get("repaired") for f in report["files"]
+               if not f["clean"])
+    again = fsck.check_dir(str(fixture_dir))
+    assert again["summary"]["files_with_findings"] == 0
+    # quarantine sidecars hold the damaged bytes + manifest
+    q0 = integrity.quarantine_dir(
+        str(fixture_dir / "shard-0" / "stream.log"))
+    assert "MANIFEST.jsonl" in os.listdir(q0)
+    # repaired WAL still replays its 4 surviving records
+    s = LogIngestionStream(str(fixture_dir / "shard-0" / "stream.log"),
+                           DEFAULT_SCHEMAS)
+    assert len(s.read(0, 100)) == 4
+    assert s.quarantined_records() == 0   # fsck already took the bytes
+    s.close()
+
+
+def test_fsck_mixed_version_format_split(tmp_path):
+    """Satellite: a stream dir with BOTH unframed and framed records in
+    the same file — fsck reports the format split per file."""
+    d = tmp_path / "shard-0"; d.mkdir()
+    path = str(d / "stream.log")
+    _write_wal(path, n=3, framed=False)
+    _write_wal(path, n=2, framed=True)
+    report = fsck.check_dir(str(tmp_path))
+    (f,) = report["files"]
+    assert f["clean"]
+    assert f["records"] == {"framed": 2, "legacy": 3}
+
+
+def test_fsck_module_subprocess_smoke(fixture_dir):
+    """One real ``python -m filodb_tpu.fsck`` invocation: JSON report,
+    exit 1 on findings, exit 0 after --repair."""
+    r = subprocess.run(
+        [sys.executable, "-m", "filodb_tpu.fsck", str(fixture_dir),
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    report = json.loads(r.stdout)
+    assert report["summary"]["files_with_findings"] == 3
+    r2 = subprocess.run(
+        [sys.executable, "-m", "filodb_tpu.fsck", str(fixture_dir),
+         "--repair", "--quiet"],
+        capture_output=True, text=True, timeout=60)
+    assert r2.returncode == 0
+    r3 = subprocess.run(
+        [sys.executable, "-m", "filodb_tpu.fsck", str(fixture_dir)],
+        capture_output=True, text=True, timeout=60)
+    assert r3.returncode == 0
+    assert "0 with findings" in r3.stdout
+
+
+def test_fsck_usage_error_on_missing_dir(tmp_path):
+    assert fsck.main([str(tmp_path / "nope")]) == 2

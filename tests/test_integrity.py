@@ -1,0 +1,528 @@
+"""Storage-integrity rail tests: frame codec, scan/quarantine on every
+durable file kind, mixed-version files, read-time verification, the
+ENOSPC ingest-read-only degradation, the quarantine knob, and the
+result cache's refusal to cache over quarantined shards.
+
+The acceptance bar (ISSUE 16): a single flipped bit in ANY durable file
+is detected, quarantined, surfaced via metrics + the event ring — and
+never reaches a query result or silently truncates replay."""
+
+import errno
+import os
+
+import pytest
+
+from filodb_tpu.core.memstore import TimeSeriesShard
+from filodb_tpu.core.record import RecordBuilder
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, DatasetRef
+from filodb_tpu.gateway.server import GatewayServer
+from filodb_tpu.ingest import IngestionDriver, LogIngestionStream
+from filodb_tpu.ingest import health as ingest_health
+from filodb_tpu.ingest.stream import encode_container, legacy_wal_probe
+from filodb_tpu.obs import events as obs_events
+from filodb_tpu.obs import metrics as obs_metrics
+from filodb_tpu.parallel.shardmapper import ShardMapper, ShardStatus
+from filodb_tpu.promql.parser import TimeStepParams, parse_query_range
+from filodb_tpu.query.engine import QueryEngine
+from filodb_tpu.store import FlatFileColumnStore, integrity
+from filodb_tpu.testing import chaos
+
+REF = DatasetRef("timeseries")
+T0 = 1_600_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    obs_metrics.GLOBAL_REGISTRY.reset()
+    obs_events.GLOBAL_EVENTS.clear()
+    ingest_health.GLOBAL.reset()
+    yield
+    obs_metrics.GLOBAL_REGISTRY.reset()
+    obs_events.GLOBAL_EVENTS.clear()
+    ingest_health.GLOBAL.reset()
+
+
+def _corruption_total(**want) -> float:
+    fam = obs_metrics.GLOBAL_REGISTRY.counter(
+        "filodb_storage_corruption_total", "")
+    return sum(v for labels, v in fam.series()
+               if all(labels.get(k) == v2 for k, v2 in want.items()))
+
+
+def _batch(i, n_rows=4):
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for r in range(n_rows):
+        b.add_sample("gauge",
+                     {"_metric_": "heap_usage", "_ws_": "demo",
+                      "_ns_": "App-0", "instance": f"i{i}"},
+                     (T0 + i * 100 + r) * 1000, float(i * 1000 + r))
+    return b.containers()
+
+
+def _flip_byte(path, pos, mask=0x01):
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ mask]))
+
+
+# -- frame codec -----------------------------------------------------------
+
+def test_frame_roundtrip():
+    for payload in (b"", b"x", b"hello world" * 100, bytes(range(256))):
+        frame = integrity.encode_frame(payload)
+        got, nxt = integrity.decode_frame(frame)
+        assert got == payload
+        assert nxt == len(frame)
+
+
+def test_frame_every_byte_position_flip_detected():
+    """Flipping ANY single bit of a frame must not verify (header
+    flips raise or fail the sniff; payload flips fail the CRC)."""
+    payload = b"the quick brown fox"
+    frame = bytearray(integrity.encode_frame(payload))
+    for pos in range(len(frame)):
+        bad = bytes(frame[:pos]) + bytes([frame[pos] ^ 0x10]) \
+            + bytes(frame[pos + 1:])
+        try:
+            got, _ = integrity.decode_frame(bad)
+        except integrity.FrameError:
+            continue
+        # decode may return None (torn: a length flip pushed the
+        # declared end past the buffer) but NEVER the wrong payload
+        assert got is None or got != payload or pos >= len(frame), \
+            f"flip at byte {pos} verified silently"
+        assert got != payload
+
+
+def test_frame_torn_buffer_returns_none():
+    frame = integrity.encode_frame(b"abcdef")
+    for cut in range(1, len(frame)):
+        got, off = integrity.decode_frame(frame[:cut])
+        assert got is None and off == 0
+
+
+# -- scanner ---------------------------------------------------------------
+
+def test_scan_mixed_framed_and_legacy_records():
+    legacy = b"".join(encode_container(c) for c in _batch(0))
+    framed = b"".join(integrity.encode_frame(encode_container(c))
+                      for c in _batch(1))
+    res = integrity.scan_buffer(legacy + framed, probe=legacy_wal_probe)
+    assert res.tail_state == "clean"
+    assert not res.corrupt
+    kinds = [r.framed for r in res.records]
+    assert False in kinds and True in kinds
+
+
+def test_scan_resyncs_past_garbage_between_frames():
+    f1 = integrity.encode_frame(b"payload-one")
+    f2 = integrity.encode_frame(b"payload-two")
+    buf = f1 + b"\x00\xde\xad\xbe\xef\x00\x17" + f2
+    res = integrity.scan_buffer(buf, probe=lambda b, o: 0)
+    assert len(res.records) == 2
+    assert len(res.corrupt) == 1
+    assert res.corrupt[0].offset == len(f1)
+    assert res.tail_state == "clean"
+
+
+# -- WAL: scan-time detection ---------------------------------------------
+
+def test_wal_bitflip_quarantined_replay_continues(tmp_path):
+    """Single bit flip mid-log: the damaged record is quarantined, the
+    records on either side still replay, metric + event fire, and the
+    flipped bytes land in the sidecar (never in results)."""
+    path = str(tmp_path / "stream.log")
+    prod = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    offs = []
+    for i in range(5):
+        for c in _batch(i):
+            offs.append(prod.append(c))
+    prod.close()
+    recs = prod._records
+    victim = recs[2]
+    _flip_byte(path, victim.payload_off + victim.payload_len // 2)
+
+    cons = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    got = cons.read(0, 100)
+    # 4 survivors; replay did NOT halt at the damage
+    assert len(got) == 4
+    assert all(len(sd.container.timestamps) == 4 for sd in got)
+    assert cons.quarantined_records() == 1
+    assert cons.quarantined_bytes() == victim.length
+    assert _corruption_total(file_kind="wal") >= 1
+    evs = obs_events.GLOBAL_EVENTS.snapshot(kind="corruption")
+    assert evs and evs[0]["file_kind"] == "wal"
+    qdir = integrity.quarantine_dir(path)
+    names = os.listdir(qdir)
+    assert f"stream.log.{victim.offset}.bad" in names
+    assert "MANIFEST.jsonl" in names
+    cons.close()
+
+
+def test_wal_read_time_two_strike_skip(tmp_path):
+    """Damage that lands AFTER scan (same-process producer index) is
+    caught by read-path re-verification: first failure retries, second
+    quarantines and advances with an empty batch."""
+    path = str(tmp_path / "stream.log")
+    s = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for i in range(5):
+        for c in _batch(i):
+            s.append(c)
+    victim = s._records[2]
+    _flip_byte(path, victim.payload_off + 2)
+
+    got1 = s.read(0, 100)
+    assert [sd.offset for sd in got1] == [0, 1]      # strike 1: stop
+    assert s.quarantined_records() == 0
+    assert _corruption_total(file_kind="wal", action="read-retry") == 1
+    got2 = s.read(2, 100)                            # strike 2: skip
+    assert [sd.offset for sd in got2] == [2, 3, 4]
+    assert len(got2[0].container.timestamps) == 0    # empty placeholder
+    assert len(got2[1].container.timestamps) > 0
+    assert s.quarantined_records() == 1
+    assert _corruption_total(file_kind="wal", action="skipped") == 1
+    s.close()
+
+
+def test_wal_legacy_garbage_no_silent_halt(tmp_path):
+    """Satellite: the pre-integrity reader stopped indexing forever at
+    the first struct-invalid legacy record, silently truncating replay.
+    Now the region is counted, quarantined, and replay resumes."""
+    path = str(tmp_path / "stream.log")
+    prod = LogIngestionStream(path, DEFAULT_SCHEMAS,
+                              integrity_frames=False)
+    for i in range(4):
+        for c in _batch(i):
+            prod.append(c)
+    prod.close()
+    second = prod._records[1]
+    # stomp the record's magic: struct-invalid, not just a bad CRC
+    _flip_byte(path, second.offset, mask=0xFF)
+    _flip_byte(path, second.offset + 1, mask=0xFF)
+
+    cons = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    got = cons.read(0, 100)
+    assert len(got) == 3                             # NOT 1: no halt
+    assert cons.quarantined_records() >= 1
+    assert _corruption_total(file_kind="wal") >= 1
+    cons.close()
+
+
+def test_wal_mixed_version_file_replays_fully(tmp_path):
+    """A stream dir written partly by an old (unframed) build and partly
+    by the new one replays every record through one consumer."""
+    path = str(tmp_path / "stream.log")
+    old = LogIngestionStream(path, DEFAULT_SCHEMAS,
+                             integrity_frames=False)
+    for i in range(3):
+        for c in _batch(i):
+            old.append(c)
+    old.close()
+    new = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for i in range(3, 6):
+        for c in _batch(i):
+            new.append(c)
+    new.close()
+
+    cons = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    got = cons.read(0, 100)
+    assert len(got) == 6
+    assert all(len(sd.container.timestamps) == 4 for sd in got)
+    assert cons.quarantined_records() == 0
+    framed = [r.framed for r in cons._records]
+    assert framed == [False] * 3 + [True] * 3
+    cons.close()
+
+
+def test_wal_torn_tail_truncated_on_takeover(tmp_path):
+    path = str(tmp_path / "stream.log")
+    s = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for c in _batch(0):
+        s.append(c)
+    s.close()
+    size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(integrity.encode_frame(b"x" * 64)[:20])   # torn append
+    cons = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    cons.end_offset()                      # force a scan
+    assert cons.tail_state() == "torn"
+    for c in _batch(1):
+        cons.append(c)                     # takeover truncates the tear
+    got = cons.read(0, 100)
+    assert len(got) == 2
+    assert cons.tail_state() == "clean"
+    cons.close()
+
+
+# -- column store: chunks / partkeys / checkpoints -------------------------
+
+def _flushed_store(tmp_path):
+    cs = FlatFileColumnStore(str(tmp_path / "col"))
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=32, column_store=cs)
+    b = RecordBuilder(DEFAULT_SCHEMAS)
+    for s in range(3):
+        labels = {"_metric_": "disk_io_total", "_ws_": "demo",
+                  "_ns_": "App-0", "instance": f"i{s}"}
+        for t in range(100):
+            b.add_sample("prom-counter", labels,
+                         (T0 + t * 10) * 1000, float((t + 1) * (s + 1)))
+    for c in b.containers():
+        shard.ingest(c, 7)
+    shard.flush_all(offset=7)
+    cs.close()
+    d = cs._shard_dir("timeseries", 0)
+    return {"chunks": os.path.join(d, "chunks.log"),
+            "partkeys": os.path.join(d, "partkeys.log"),
+            "checkpoint": os.path.join(d, "checkpoints.json"),
+            "root": str(tmp_path / "col")}
+
+
+def test_chunklog_bitflip_skipped_counted_query_survives(tmp_path):
+    paths = _flushed_store(tmp_path)
+    # flip a payload byte inside the SECOND framed record so the scan
+    # index stays intact but that chunk's CRC fails
+    with open(paths["chunks"], "rb") as f:
+        buf = f.read()
+    res = integrity.scan_buffer(buf, probe=lambda b, o: 0)
+    assert len(res.records) >= 2 and all(r.framed for r in res.records)
+    victim = res.records[1]
+    _flip_byte(paths["chunks"], victim.payload_off + victim.payload_len // 2)
+
+    cs = FlatFileColumnStore(paths["root"])
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=32, column_store=cs)
+    shard.bootstrap_from_store()
+    plan = parse_query_range("disk_io_total",
+                             TimeStepParams(T0, 60, T0 + 990))
+    res_q = QueryEngine([shard]).execute(plan)   # must not raise
+    assert cs.quarantined_records("timeseries", 0) >= 1
+    assert _corruption_total(file_kind="chunklog") >= 1
+    assert obs_events.GLOBAL_EVENTS.snapshot(kind="corruption")
+    cs.close()
+
+
+def test_partkeys_bitflip_entry_skipped_and_counted(tmp_path):
+    paths = _flushed_store(tmp_path)
+    with open(paths["partkeys"], "rb") as f:
+        buf = f.read()
+    res = integrity.scan_buffer(buf, probe=lambda b, o: 0)
+    n_entries = len(res.records)
+    assert n_entries == 3
+    victim = res.records[1]
+    _flip_byte(paths["partkeys"], victim.payload_off + 4)
+
+    cs = FlatFileColumnStore(paths["root"])
+    entries = list(cs.scan_part_keys("timeseries", 0))
+    assert len(entries) == n_entries - 1
+    assert cs.quarantined_records("timeseries", 0) >= 1
+    assert _corruption_total(file_kind="partkeys") >= 1
+    cs.close()
+
+
+def test_checkpoint_bitflip_read_empty_and_counted(tmp_path):
+    paths = _flushed_store(tmp_path)
+    size = os.path.getsize(paths["checkpoint"])
+    _flip_byte(paths["checkpoint"], size // 2)
+
+    cs = FlatFileColumnStore(paths["root"])
+    # unverifiable checkpoint -> replay from 0 (safe), never bad data
+    assert cs.read_checkpoints("timeseries", 0) == {}
+    assert cs.quarantined_records("timeseries", 0) >= 1
+    assert _corruption_total(file_kind="checkpoint") >= 1
+    cs.close()
+
+
+def test_checkpoint_rewrite_heals(tmp_path):
+    paths = _flushed_store(tmp_path)
+    _flip_byte(paths["checkpoint"], os.path.getsize(paths["checkpoint"]) // 2)
+    cs = FlatFileColumnStore(paths["root"])
+    assert cs.read_checkpoints("timeseries", 0) == {}
+    cs.write_checkpoint("timeseries", 0, 0, 11)
+    cs.write_checkpoint("timeseries", 0, 1, 12)
+    assert cs.read_checkpoints("timeseries", 0) == {0: 11, 1: 12}
+    cs.close()
+
+
+# -- ENOSPC: clean ingest-read-only degradation ----------------------------
+
+def test_health_enospc_flips_read_only_and_recovers():
+    h = ingest_health.IngestHealth(probe_interval_s=0.0)
+    e = OSError(errno.ENOSPC, "no space left on device")
+    assert h.note_write_error(e, "unit") is True
+    assert h.read_only()
+    # non-space errors are the caller's problem, state unchanged
+    assert h.note_write_error(OSError(errno.EPERM, "x"), "unit") is False
+    with pytest.raises(ingest_health.IngestReadOnly) as ei:
+        raise h.reject()
+    assert ei.value.retry_after_s > 0
+    h.note_write_ok()
+    assert not h.read_only()
+    evs = obs_events.GLOBAL_EVENTS.snapshot(kind="ingest-read-only")
+    assert [e["state"] for e in evs] == ["recovered", "entered"]
+
+
+def test_gateway_enospc_degrades_then_auto_recovers(tmp_path):
+    """ENOSPC mid-ingest through the gateway publish path: process
+    flips to ingest-read-only (counted drops, no crashed thread),
+    queries would keep serving, and the first successful probe write
+    recovers automatically."""
+    path = str(tmp_path / "stream.log")
+    stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    gw = GatewayServer({0: stream}, DEFAULT_SCHEMAS, num_shards=1,
+                       spread=0)
+    ingest_health.GLOBAL.probe_interval_s = 0.0   # probe every publish
+    line = "reqs,instance=i0 total=1 1600000000000000000"
+    try:
+        inj = chaos.ChaosInjector()
+        inj.fail("wal.append", exc=chaos.enospc, times=1)
+        with inj:
+            builders = {}
+            assert gw._route_line(line, builders)
+            gw._publish(builders)                 # hits injected ENOSPC
+        assert ingest_health.GLOBAL.read_only()
+        assert gw.batches_dropped == 1
+        n0 = stream.end_offset()
+        # next publish is the recovery probe; disk is "fixed" now
+        builders = {}
+        gw._route_line(line, builders)
+        gw._publish(builders)
+        assert not ingest_health.GLOBAL.read_only()
+        assert stream.end_offset() == n0 + 1      # probe write landed
+    finally:
+        gw._server.server_close()
+        stream.close()
+
+
+def test_gateway_read_only_raises_for_http_edge(tmp_path):
+    path = str(tmp_path / "stream.log")
+    stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    gw = GatewayServer({0: stream}, DEFAULT_SCHEMAS, num_shards=1,
+                       spread=0)
+    ingest_health.GLOBAL.probe_interval_s = 3600.0
+    ingest_health.GLOBAL.note_write_error(
+        OSError(errno.ENOSPC, "no space"), "unit")
+    ingest_health.GLOBAL.should_probe()           # burn the probe slot
+    try:
+        builders = {}
+        gw._route_line("reqs,instance=i0 total=1 1600000000000000000",
+                       builders)
+        with pytest.raises(ingest_health.IngestReadOnly):
+            gw._publish(builders, raise_on_error=True)
+    finally:
+        gw._server.server_close()
+        stream.close()
+
+
+# -- quarantine knob: shard degrades to read-only --------------------------
+
+def test_quarantine_knob_degrades_shard_to_read_only(tmp_path):
+    """integrity-max-quarantined-records=0 (the default): ANY
+    quarantined record stops the shard from applying NEW batches —
+    but startup replay still applies every checksum-verified survivor
+    (read-only must not turn one bad record into a whole-shard
+    truncation), and the mapper stays ACTIVE so queries keep serving."""
+    path = str(tmp_path / "stream.log")
+    prod = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for i in range(4):
+        for c in _batch(i):
+            prod.append(c)
+    prod.close()
+    victim = prod._records[1]
+    _flip_byte(path, victim.payload_off + 3)
+
+    stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    mapper = ShardMapper(1)
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=64)
+    drv = IngestionDriver(shard, stream, mapper=mapper,
+                          poll_interval_s=0.01,
+                          max_quarantined_records=0)
+    drv.start()
+    import time
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not shard.integrity_read_only:
+        time.sleep(0.01)
+    assert shard.integrity_read_only
+    # recovery completes past the trip: all 3 surviving batches land
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and drv.next_offset < 3:
+        time.sleep(0.01)
+    assert drv.next_offset == 3
+    assert shard.stats.rows_ingested == 3 * 4
+    # ...but NEW post-recovery appends are gated by read-only
+    for c in _batch(9):
+        stream.append(c)
+    time.sleep(0.2)
+    drv.stop()
+    assert shard.stats.rows_ingested == 3 * 4
+    assert shard.integrity_quarantined_records == 1
+    # read-only != down: still queryable
+    assert mapper.status(0).queryable
+    evs = obs_events.GLOBAL_EVENTS.snapshot(kind="integrity-read-only")
+    assert evs and evs[0]["shard"] == 0
+    gauges = obs_metrics.GLOBAL_REGISTRY.gauge(
+        "filodb_shard_integrity_read_only", "").series()
+    assert any(v == 1.0 for _, v in gauges)
+    stream.close()
+
+
+def test_quarantine_knob_tolerance_allows_bounded_loss(tmp_path):
+    """A nonzero knob tolerates that much loss and keeps ingesting."""
+    path = str(tmp_path / "stream.log")
+    prod = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    for i in range(4):
+        for c in _batch(i):
+            prod.append(c)
+    prod.close()
+    victim = prod._records[1]
+    _flip_byte(path, victim.payload_off + 3)
+
+    stream = LogIngestionStream(path, DEFAULT_SCHEMAS)
+    mapper = ShardMapper(1)
+    shard = TimeSeriesShard(REF, DEFAULT_SCHEMAS, 0, num_groups=2,
+                            max_chunk_rows=64)
+    drv = IngestionDriver(shard, stream, mapper=mapper,
+                          poll_interval_s=0.01,
+                          max_quarantined_records=5)
+    drv.start()
+    import time
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and drv.next_offset < 3:
+        time.sleep(0.01)
+    drv.stop()
+    assert not shard.integrity_read_only
+    assert shard.stats.rows_ingested == 3 * 4     # 3 surviving batches
+    assert shard.integrity_quarantined_records == 1
+    stream.close()
+
+
+# -- result cache refusal --------------------------------------------------
+
+def test_resultcache_refuses_quarantined_shards():
+    from filodb_tpu.query.resultcache import ResultCache, shards_quarantine
+
+    class _Shard:
+        def __init__(self, wm, q=0):
+            self.ingest_watermark_ms = wm
+            self.ingest_backfill_epoch = 0
+            self.integrity_quarantined_records = q
+
+    class _Eng:
+        def __init__(self, shards):
+            self.shards = shards
+
+    assert shards_quarantine([_Shard(0, 0), _Shard(0, 2)]) == 2
+    rc = ResultCache(hot_window_ms=0)
+    clean = _Eng([_Shard(10_000_000_000)])
+    dirty = _Eng([_Shard(10_000_000_000, q=1)])
+    plan = parse_query_range("up", TimeStepParams(1000, 60, 2000))
+    h = rc.begin(clean, "ds", "up", plan, 1_000_000, 60_000, 2_000_000)
+    assert h.state != "uncacheable"
+    h2 = rc.begin(dirty, "ds", "up", plan, 1_000_000, 60_000, 2_000_000)
+    assert h2.state == "uncacheable"
+    assert rc.integrity_refused == 1
+    assert rc.stale_serve(dirty, "ds", "up", plan, 1_000_000, 60_000,
+                          2_000_000) is None
