@@ -292,6 +292,32 @@ def main():
         e2e = {"value": None, "p95_ms": None, "qps": None,
                "error": f"{type(e).__name__}: {e}"}
 
+    # capacity ledger (graftlint v5): certify the @capacity inventory
+    # in-process and write CAPACITY.json beside this line; the resident
+    # numbers below price the CERTIFIED shardstore claim at this bench
+    # shape (pow2 slot capacity over N — padding is real HBM), the
+    # baseline the compressed-chunks work must move (ROADMAP item 1)
+    _mark("capacity certification + ledger")
+    try:
+        from filodb_tpu.lint import memcert
+        from filodb_tpu.lint.capacity import capacity_claim
+        from filodb_tpu.parallel.shardstore import _next_pow2
+        ledger = memcert.capacity_ledger(samples_per_series=N)
+        assert all(row["certified"] for row in ledger), \
+            [r["family"] for r in ledger if not r["certified"]]
+        with open("CAPACITY.json", "w") as f:
+            json.dump({"samples_per_series": N,
+                       "hbm_bytes_per_chip": 16 << 30,
+                       "families": ledger}, f, indent=2, sort_keys=True)
+            f.write("\n")
+        cl = capacity_claim("shardstore-resident-channels")
+        cap_slots = _next_pow2(N, 64)
+        resident_bps = round(cl.bytes_per_sample * cap_slots / N, 2)
+        projected_spc = cl.projected_series_per_chip(cap_slots)
+    except Exception as e:              # ledger is telemetry, not a gate
+        _mark(f"capacity ledger failed: {type(e).__name__}: {e}")
+        resident_bps = projected_spc = None
+
     print(json.dumps({
         "metric": "rate_sum_by_samples_scanned_per_sec",
         "value": round(device_sps),
@@ -316,6 +342,12 @@ def main():
         "e2e_p50_ms": e2e["value"],
         "e2e_p95_ms": e2e["p95_ms"],
         "e2e_qps": e2e["qps"],
+        # certified residency (graftlint v5 capacity rail): bytes per
+        # LOGICAL sample at this shape (the 20 B/padded-slot shardstore
+        # claim times the pow2 capacity pad) and the resident-series
+        # ceiling one 16 GB chip implies at 8h@10s retention
+        "resident_bytes_per_sample": resident_bps,
+        "projected_series_per_chip_16gb": projected_spc,
     }))
 
 

@@ -27,6 +27,7 @@ from filodb_tpu.core.record import PartKey, RecordContainer
 from filodb_tpu.core.schemas import (DEFAULT_SCHEMAS, ColumnType, DatasetRef,
                                      Schemas)
 from filodb_tpu.downsample import kernels
+from filodb_tpu.lint.capacity import capacity
 from filodb_tpu.memory import vectors as bv
 from filodb_tpu.query.tpu import _TS_PAD, _next_pow2
 
@@ -178,6 +179,13 @@ class DownsamplerJob:
             s.stats.chunks_persisted for s in out_shards.values())
         return stats
 
+    @capacity(
+        "downsample-pack-buffers", bytes_per_sample=16.0,
+        reason="the padded batch staging block the downsample kernels "
+               "consume on device is [S, pow2(maxlen)] int64 "
+               "timestamps (8 B) + f64 values (8 B) = 16 B per padded "
+               "slot, alive for one batch dispatch (the lens vector "
+               "and period outputs are host-side)")
     def _pack(self, batch):
         S = len(batch)
         maxlen = max(ts.size for _, _, ts, _ in batch)

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 from filodb_tpu.http import prom_json
 from filodb_tpu.ingest import health as ingest_health
+from filodb_tpu.lint import capacity as lint_capacity
 from filodb_tpu.lint.caches import publishes
 from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import events as obs_events
@@ -1724,7 +1725,8 @@ class FiloHttpServer:
                 qos_info["shed"] = stages["qosShed"]
         return obs_devprof.analyze_payload(
             tr.spans_json(), stages, batcher_stats=batcher_stats,
-            qos_info=qos_info)
+            qos_info=qos_info,
+            residency=lint_capacity.residency_snapshot())
 
     def _debug_traces(self, qs):
         """GET /debug/traces: recent finished traces (summaries), or one

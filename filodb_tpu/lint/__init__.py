@@ -292,7 +292,8 @@ def _load_rule_modules() -> None:
     if _rule_modules_loaded:
         return
     _rule_modules_loaded = True
-    from filodb_tpu.lint import (rules_cache,  # noqa: F401
+    from filodb_tpu.lint import (memcert,  # noqa: F401
+                                 rules_cache, rules_capacity,
                                  rules_concurrency, rules_hot,
                                  rules_kernel, rules_lock,
                                  rules_numerics, rules_promql,
@@ -324,8 +325,9 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     from filodb_tpu.lint.ulpcert import ensure_virtual_devices
     ensure_virtual_devices()
     _load_rule_modules()
-    from filodb_tpu.lint import (rules_cache, rules_concurrency,
-                                 rules_hot, rules_kernel, rules_lock,
+    from filodb_tpu.lint import (rules_cache, rules_capacity,
+                                 rules_concurrency, rules_hot,
+                                 rules_kernel, rules_lock,
                                  rules_numerics, rules_promql,
                                  rules_span, rules_spmd, rules_trace)
     from filodb_tpu.lint import callgraph as _cgmod
@@ -373,6 +375,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         raw.append((bymod_path.get(relpath), f))
     for relpath, f in rules_numerics.check_project(mods, cg=cg, df=df):
         raw.append((bymod_path.get(relpath), f))
+    for relpath, f in rules_capacity.check_project(mods, cg=cg, df=df):
+        raw.append((bymod_path.get(relpath), f))
     # promql family: shipped rule-file sweep + (full runs only) the
     # seeded differential micro-soak. --changed-only skips the soak —
     # the fast pre-commit path; tier-1 runs the full rail.
@@ -393,6 +397,14 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
         if report_only is None:
             from filodb_tpu.lint import ulpcert
             for relpath, f in ulpcert.check_certifications(mods):
+                mod = bymod.get(relpath)
+                raw.append((mod, f) if mod is not None else (None, f))
+            # the capacity-certification rail (v5): every @capacity
+            # residency claim is built at seeded sizes and its real
+            # device bytes measured; sharded claims at 1/2/4/8 virtual
+            # devices. Memoized like ulpcert.
+            from filodb_tpu.lint import memcert
+            for relpath, f in memcert.check_certifications(mods):
                 mod = bymod.get(relpath)
                 raw.append((mod, f) if mod is not None else (None, f))
     for mod, f in raw:

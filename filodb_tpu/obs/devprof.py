@@ -426,7 +426,8 @@ def note_dispatch(site: str, key: Tuple, first_seen: bool,
 
 def analyze_payload(spans: List[Dict], stages: Dict,
                     batcher_stats: Optional[Dict] = None,
-                    qos_info: Optional[Dict] = None) -> Dict:
+                    qos_info: Optional[Dict] = None,
+                    residency: Optional[Dict] = None) -> Dict:
     """The ``&explain=analyze`` envelope: per-stage timings (the spans
     PR 4's ``&explain=trace`` already records), the executables this
     query's dispatches actually ran — identity, compile disposition,
@@ -473,4 +474,10 @@ def analyze_payload(spans: List[Dict], stages: Dict,
         out["batcher"] = batcher_stats
     if qos_info is not None:
         out["qos"] = qos_info
+    if residency:
+        out["residency"] = {
+            family: {"shards": dict(shards),
+                     "total_bytes": sum(shards.values())}
+            for family, shards in residency.items()
+        }
     return out

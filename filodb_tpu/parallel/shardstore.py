@@ -53,6 +53,9 @@ import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 from filodb_tpu.lint.caches import cache_registry
+from filodb_tpu.lint.capacity import (capacity, drop_resident,
+                                      ensure_residency_collector,
+                                      record_resident)
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.locks import guarded_by
 from filodb_tpu.lint.numerics import order_insensitive, precision
@@ -319,6 +322,14 @@ def _next_pow2(n: int, lo: int = 8) -> int:
     return p
 
 
+@capacity(
+    "shardstore-resident-channels", bytes_per_sample=20.0, sharded=True,
+    reason="the resident store keeps three [cap, S_pad] slot-major "
+           "channels — int32 relative timestamps (4 B) + raw f64 "
+           "values (8 B) + counter-corrected f64 values (8 B) = 20 B "
+           "per PADDED slot (pow2 slot capacity, shard-aligned series "
+           "pad); the non-counter _aligned placements are transient "
+           "per-family row sets cleared on every refresh")
 class ShardedTiles:
     """One aligned-tile cohort resident across the mesh: capacity-padded
     [cap, S_pad] slot-major channels (int32 relative timestamps, raw
@@ -355,6 +366,20 @@ class ShardedTiles:
         self._cv = place(cv.T, np.float64)
         # non-counter aligned channel placements, per function family
         self._aligned: Dict[Tuple, Dict[str, jnp.ndarray]] = {}
+        # runtime residency accounting: live device bytes under the
+        # filodb_device_memory_bytes{family,shard} gauge, dropped when
+        # the store is collected
+        ensure_residency_collector()
+        self._res_key = ("shardstore-resident-channels", str(n_shard),
+                         id(self))
+        weakref.finalize(self, drop_resident, *self._res_key)
+        self._record_residency()
+
+    def _record_residency(self) -> None:
+        nbytes = int(self._tsr.nbytes + self._v.nbytes + self._cv.nbytes)
+        nbytes += sum(int(a.nbytes) for placed in self._aligned.values()
+                      for a in placed.values())
+        record_resident(*self._res_key, nbytes)
 
     # -- eligibility -------------------------------------------------------
 
@@ -450,6 +475,7 @@ class ShardedTiles:
                         [h, np.zeros((pad,) + h.shape[1:], h.dtype)])
                 placed[k] = jax.device_put(h, row if h.ndim == 1 else row2)
             self._aligned[key] = placed
+            self._record_residency()
         return placed
 
     def eval_aligned(self, tiles, func: str, steps: np.ndarray,
@@ -571,6 +597,7 @@ class ShardedTiles:
             np.int64(self.n_filled))
         self.n_filled = n_new
         self._aligned.clear()   # row-major placements are per-snapshot
+        self._record_residency()
         return True
 
 

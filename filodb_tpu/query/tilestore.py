@@ -38,6 +38,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from filodb_tpu.lint.capacity import capacity
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.numerics import order_insensitive, precision  # noqa: F401
 from filodb_tpu.query.model import RawSeries
@@ -61,6 +62,14 @@ def _ffill_idx(valid: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.cummax(jnp.where(valid, idx, jnp.int32(-1)), axis=1)
 
 
+@capacity(
+    "tilestore-aligned-tiles", bytes_per_sample=17.0,
+    reason="the base device residency of an aligned cohort is three "
+           "[S, N] tiles — validity bool (1 B) + true-timestamp f64 "
+           "(8 B) + value f64 (8 B) = 17 B per slot; the derived "
+           "channels (ones/cv/prefix sums/transposes) are lazy "
+           "per-function warm caches over the same slot count, not "
+           "part of the cold footprint")
 class AlignedTiles:
     """One cohort of series sharing cadence dt, as device tiles."""
 
@@ -1104,6 +1113,13 @@ _JIT_STATS_LOCK = _threading.Lock()
 __guarded_by__ = {"_JIT_STATS": "_JIT_STATS_LOCK"}
 
 
+@capacity(
+    "tilestore-executable-constants", bytes_per_sample=8.0,
+    reason="dispatch-table entries retain the device constants their "
+           "closures capture (weight/shape tables lowered into the "
+           "compiled program), priced at one f64 (8 B) per packed "
+           "slot of the largest captured constant; the executables "
+           "themselves are host code, not HBM")
 def _jit_lookup(cache: Dict[Tuple, object], key: Tuple, build,
                 site: str = "tilestore", cost_args=None) -> object:
     """Dispatch-table lookup with hit/miss accounting; ``build()`` makes

@@ -42,6 +42,7 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 
 from filodb_tpu.lint.caches import cache_registry
+from filodb_tpu.lint.capacity import capacity
 from filodb_tpu.lint.contracts import kernel_contract
 from filodb_tpu.lint.numerics import precision
 from filodb_tpu.lint.hotpath import hot_path
@@ -860,6 +861,13 @@ class TpuBackend:
         return _TileEntry(tiles, idx, prefix_has_nan,
                           None if use_snap else list(series), cov_min)
 
+    @capacity(
+        "device-tile-cache", bytes_per_sample=17.0,
+        reason="each tile-cache entry retains one AlignedTiles cohort "
+               "(valid bool + ts f64 + vals f64 = 17 B per slot) over "
+               "the selection's immutable chunk prefix, FIFO-capped "
+               "at _TILE_CACHE_MAX entries; warm channel caches on "
+               "the retained cohort are priced by the tilestore claim")
     def _insert_tile_entry(self, key, ident, entry) -> None:
         with self._tile_lock:
             while len(self._tile_cache) >= self._TILE_CACHE_MAX:

@@ -138,6 +138,20 @@ def test_analyze_payload_attribution():
     assert out["stages"]["qosShed"] == "stale"
     assert out["batcher"]["occupancy_avg"] == 1.5
     assert out["qos"]["tenant"] == "t"
+    assert "residency" not in out          # no snapshot passed
+
+
+def test_analyze_payload_residency_section():
+    """The graftlint v5 residency registry rides &explain=analyze:
+    per-family shard breakdown plus a total."""
+    out = devprof.analyze_payload(
+        [], {}, residency={"shardstore-resident-channels":
+                           {"1": 20480, "2": 40960}})
+    res = out["residency"]["shardstore-resident-channels"]
+    assert res["shards"] == {"1": 20480, "2": 40960}
+    assert res["total_bytes"] == 61440
+    assert "residency" not in devprof.analyze_payload([], {},
+                                                     residency={})
 
 
 # ---------------------------------------------------------------------------

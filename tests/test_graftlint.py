@@ -1846,7 +1846,7 @@ def test_sarif_report_shape(tmp_path):
     families = {r["properties"]["family"]
                 for r in run["tool"]["driver"]["rules"]}
     assert {"kernel", "trace", "lock", "concurrency", "spmd", "cache",
-            "promql", "numerics", "meta"} <= families
+            "promql", "numerics", "capacity", "meta"} <= families
     (result,) = run["results"]
     assert result["ruleId"] == "precision-narrowing"
     assert result["level"] == "error"
@@ -1879,3 +1879,243 @@ def test_cli_sarif_flag(tmp_path):
     doc = json.loads(proc.stdout)
     assert doc["version"] == "2.1.0"
     assert doc["runs"][0]["results"][0]["ruleId"] == "precision-narrowing"
+
+
+# -- graftlint v5: device-memory residency & capacity families ---------------
+
+RESIDENT_VIOLATION = """
+import jax.numpy as jnp
+
+
+class Store:
+    def __init__(self):
+        self._buf = jnp.zeros((64, 64))
+"""
+
+RESIDENT_CLEAN = """
+import jax.numpy as jnp
+from filodb_tpu.lint.capacity import capacity
+
+
+@capacity("fixture-store", bytes_per_sample=8.0,
+          reason="one f64 cell per padded slot")
+class Store:
+    def __init__(self):
+        self._buf = jnp.zeros((64, 64))
+"""
+
+RESIDENT_PRAGMA = """
+import jax.numpy as jnp
+
+
+class Store:
+    def __init__(self):
+        # graftlint: disable=hbm-residency-budget (fixture: priced elsewhere)
+        self._buf = jnp.zeros((64, 64))
+"""
+
+RESIDENT_MODULE_GLOBAL = """
+import jax.numpy as jnp
+
+LUT = jnp.arange(4096)
+"""
+
+
+def test_hbm_residency_budget(tmp_path):
+    assert rules_of(lint_src(tmp_path, RESIDENT_VIOLATION)) \
+        == ["hbm-residency-budget"]
+    assert not lint_src(tmp_path, RESIDENT_CLEAN).findings
+    assert not lint_src(tmp_path, RESIDENT_PRAGMA).findings
+    res = lint_src(tmp_path, RESIDENT_MODULE_GLOBAL)
+    assert rules_of(res) == ["hbm-residency-budget"]
+    assert "process lifetime" in res.findings[0].message
+
+
+LEAK_NO_EVICTION = """
+import jax.numpy as jnp
+from filodb_tpu.lint.caches import cache_registry
+from filodb_tpu.lint.capacity import capacity
+
+
+@cache_registry("fixture-tiles", keyed=("selection",))
+@capacity("fixture-tile-store", bytes_per_sample=8.0,
+          reason="tiles priced per slot")
+class TileCache:
+    def __init__(self):
+        self._tiles = {}
+
+    def insert(self, key):
+        self._tiles[key] = jnp.zeros((64,))
+"""
+
+LEAK_EVICTED = LEAK_NO_EVICTION + """
+    def evict(self, key):
+        self._tiles.pop(key, None)
+"""
+
+LEAK_DOUBLE_RETENTION = """
+import jax.numpy as jnp
+from filodb_tpu.lint.capacity import capacity
+
+
+@capacity("fixture-pair", bytes_per_sample=8.0, reason="priced")
+class Pair:
+    def __init__(self):
+        buf = jnp.zeros((64,))
+        self._a = buf
+        self._b = buf
+"""
+
+
+def test_device_buffer_leak(tmp_path):
+    res = lint_src(tmp_path, LEAK_NO_EVICTION)
+    assert rules_of(res) == ["device-buffer-leak"]
+    assert "no eviction operation" in res.findings[0].message
+    assert not lint_src(tmp_path, LEAK_EVICTED).findings
+    res = lint_src(tmp_path, LEAK_DOUBLE_RETENTION)
+    assert rules_of(res) == ["device-buffer-leak"]
+    assert "2 stores" in res.findings[0].message
+
+
+TRANSFER_PULL = """
+import numpy as np
+from filodb_tpu.lint.hotpath import hot_path
+
+
+class Chan:
+    @hot_path
+    def read_all(self):
+        # graftlint: disable=host-transfer-in-hot-loop (fixture: sync noted)
+        return np.asarray(self._dev)
+"""
+
+TRANSFER_PULL_SLICED = """
+import numpy as np
+from filodb_tpu.lint.hotpath import hot_path
+
+
+class Chan:
+    @hot_path
+    def read_window(self, n):
+        # graftlint: disable=host-transfer-in-hot-loop (fixture: sync noted)
+        return np.asarray(self._dev[:n])
+"""
+
+TRANSFER_PADDED = """
+import numpy as np
+import jax
+from filodb_tpu.lint.hotpath import hot_path
+
+
+def _next_pow2(n, lo):
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+@hot_path
+def ship(ts):
+    cap = _next_pow2(ts.size, 64)
+    buf = np.zeros((cap,))
+    buf[:ts.size] = ts
+    return jax.device_put(buf)
+"""
+
+TRANSFER_PADDED_PRICED = TRANSFER_PADDED.replace(
+    "@hot_path",
+    """from filodb_tpu.lint.capacity import capacity
+
+
+@capacity("fixture-staged", bytes_per_sample=8.0,
+          reason="padded staging block priced per slot")
+@hot_path""")
+
+
+def test_oversized_transfer(tmp_path):
+    res = lint_src(tmp_path, TRANSFER_PULL)
+    assert rules_of(res) == ["oversized-transfer"]
+    assert "whole resident channel" in res.findings[0].message
+    assert not lint_src(tmp_path, TRANSFER_PULL_SLICED).findings
+    res = lint_src(tmp_path, TRANSFER_PADDED)
+    assert rules_of(res) == ["oversized-transfer"]
+    assert "pow2-capacity-padded" in res.findings[0].message
+    assert not lint_src(tmp_path, TRANSFER_PADDED_PRICED).findings
+
+
+VMEM_OVER_BUDGET = """
+def choose(nsteps, vmem_budget=32 << 20):
+    for tt in (512, 256):
+        if tt * nsteps * 4 <= vmem_budget:
+            return tt
+    return None
+"""
+
+VMEM_UNTESTED = """
+def walk(nsteps, vmem_budget=14 << 20):
+    total = 0
+    for tt in (512, 256):
+        total += tt * nsteps
+    return total
+"""
+
+VMEM_CLEAN = """
+def choose(nsteps, vmem_budget=14 << 20):
+    for tt in (512, 256):
+        if tt * nsteps * 4 <= vmem_budget:
+            return tt
+    return None
+"""
+
+VMEM_PRAGMA = """
+# graftlint: disable=vmem-frontier-budget (fixture: host-side prototype)
+def walk(nsteps, vmem_budget=14 << 20):
+    total = 0
+    for tt in (512, 256):
+        total += tt * nsteps
+    return total
+"""
+
+
+def test_vmem_frontier_budget(tmp_path):
+    res = lint_src(tmp_path, VMEM_OVER_BUDGET)
+    assert rules_of(res) == ["vmem-frontier-budget"]
+    assert "exceeds physical per-core VMEM" in res.findings[0].message
+    res = lint_src(tmp_path, VMEM_UNTESTED)
+    assert rules_of(res) == ["vmem-frontier-budget"]
+    assert "never compares" in res.findings[0].message
+    assert not lint_src(tmp_path, VMEM_CLEAN).findings
+    assert not lint_src(tmp_path, VMEM_PRAGMA).findings
+
+
+def test_capacity_families_flow_through_json_github_changed(tmp_path):
+    """The v5 families ride the generic reporting rails: --json carries
+    the rule ids, --github renders error annotations, --sarif carries
+    the capacity family in the driver catalog, and report_only
+    (--changed-only) filters findings anchored elsewhere."""
+    from filodb_tpu.lint.ci_annotations import github_annotations, \
+        sarif_report
+    res = lint_src(tmp_path, RESIDENT_VIOLATION)
+    payload = res.to_json()
+    assert payload["exit_code"] == 1
+    assert [f["rule"] for f in payload["findings"]] \
+        == ["hbm-residency-budget"]
+    lines = github_annotations(payload)
+    assert len(lines) == 1 \
+        and "graftlint hbm-residency-budget" in lines[0]
+    assert lines[0].startswith("::error ")
+    doc = sarif_report(payload)
+    run = doc["runs"][0]
+    assert "capacity" in {r["properties"]["family"]
+                          for r in run["tool"]["driver"]["rules"]}
+    assert run["results"][0]["ruleId"] == "hbm-residency-budget"
+    # report_only: same tree, findings anchored outside the changed set
+    # are dropped while the analysis stays whole-program
+    p = tmp_path / "fixture.py"
+    full = run_lint([str(p)], baseline=frozenset(),
+                    check_contracts=False)
+    assert full.findings
+    other = run_lint([str(p)], baseline=frozenset(),
+                     check_contracts=False,
+                     report_only=frozenset(["somewhere/else.py"]))
+    assert not other.findings

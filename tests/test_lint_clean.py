@@ -63,12 +63,41 @@ def test_v4_families_enabled_at_error():
         assert cat[rid].severity == "error"
 
 
+def test_v5_families_enabled_at_error():
+    """The four graftlint v5 capacity families + the capacity-
+    certification rail ride the tier-1 gate at error severity. The
+    full run above exercises them: the residency dataflow sweeps every
+    untraced function, the frontier sweep re-derives the groupsum
+    chooser grid against the kernel contract, and check_contracts=True
+    certifies every @capacity claim (sharded claims at 1/2/4/8 virtual
+    devices)."""
+    from filodb_tpu.lint import rules
+    cat = rules()
+    for rid in ("hbm-residency-budget", "device-buffer-leak",
+                "oversized-transfer", "vmem-frontier-budget",
+                "capacity-certification"):
+        assert cat[rid].severity == "error"
+        assert cat[rid].family == "capacity"
+
+
 def test_tree_annotations_all_certified():
     """Belt-and-braces alongside the run_lint sweep: the certification
     results themselves (memoized from the gate run) are all green."""
     from filodb_tpu.lint import ulpcert
     results = ulpcert.certify_all()
     assert len(results) >= 8
+    bad = [r for r in results if not r.ok]
+    assert not bad, bad
+
+
+def test_tree_capacity_claims_all_certified():
+    """Same for the v5 rail: every in-tree @capacity claim certifies
+    (memoized from the gate run — the resident shardstore channels,
+    tilestore tiles, executable constants, the tile cache, and the
+    downsample staging buffers)."""
+    from filodb_tpu.lint import memcert
+    results = memcert.certify_all()
+    assert len(results) >= 5
     bad = [r for r in results if not r.ok]
     assert not bad, bad
 
