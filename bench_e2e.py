@@ -1086,6 +1086,142 @@ def measure_selfmon_overhead(clients=8, duration_s=2.5,
     return out
 
 
+def measure_profiler_overhead(clients=8, duration_s=2.5, hz=29.0,
+                              trials=3):
+    """Sampling-profiler cost under the 8-client dashboard load, same
+    interleaved best-of-``trials`` design as the selfmon harness: two
+    servers (profiler off / on at the default hz) alive for the whole
+    measurement, trials alternating. Besides client-side qps/p99, the
+    sampler's own tick histogram gives the noise-free number: duty
+    cycle = mean tick cost x hz. The /debug/profile report closes the
+    attribution acceptance (fraction of samples landing on a declared
+    thread root)."""
+    out = {"clients": clients, "hz": hz, "trials": trials}
+    procs = {}
+    ports = {}
+    try:
+        for mode in ("profiler_off", "profiler_on"):
+            port = _free_port()
+            cfg = {
+                "num-shards": 4, "port": port, "gateway-port": None,
+                "seed-dev-data": True, "seed-start-ms": T0 * 1000,
+                "seed-samples": SEED_SAMPLES,
+                "seed-instances": N_INSTANCES,
+                "query-sample-limit": 0, "query-series-limit": 0,
+                "max-inflight-queries": 8,
+                "grpc-port": None,
+            }
+            if mode == "profiler_on":
+                cfg["profiler-enabled"] = True
+                cfg["profiler-hz"] = hz
+            procs[mode], _line = _spawn_node(cfg)
+            ports[mode] = port
+
+        def one(cl, i):
+            t0 = time.perf_counter()
+            raw = cl.get_raw(
+                "/promql/timeseries/api/v1/query_range",
+                query="rate(http_requests_total[5m])",
+                start=T0 + 600 + (i % 8) * 10,
+                end=T0 + 900 + (i % 8) * 10, step=30)
+            dt = time.perf_counter() - t0
+            assert raw.startswith(b'{"status":"success"'), raw[:120]
+            return dt
+
+        for mode in ("profiler_off", "profiler_on"):
+            warm = KeepAliveClient(ports[mode])
+            for i in range(8):      # compile every query shape
+                one(warm, i)
+            warm.close()
+
+        def run_trial(port):
+            lats = []
+            lock = threading.Lock()
+            t_end = time.perf_counter() + duration_s
+
+            def loop(cid):
+                c = KeepAliveClient(port)
+                i = 0
+                while time.perf_counter() < t_end:
+                    dt = one(c, cid * 13 + i)
+                    i += 1
+                    with lock:
+                        lats.append(dt)
+                c.close()
+            threads = [threading.Thread(target=loop, args=(c,))
+                       for c in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            lats_ms = np.asarray(lats) * 1000
+            return {
+                "qps": round(len(lats) / duration_s, 1),
+                "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+                "p99_ms": round(float(np.percentile(lats_ms, 99)), 2),
+                "queries": len(lats),
+            }
+
+        runs = {"profiler_off": [], "profiler_on": []}
+        for t in range(max(1, trials)):
+            order = ("profiler_off", "profiler_on") if t % 2 == 0 \
+                else ("profiler_on", "profiler_off")
+            for mode in order:
+                runs[mode].append(run_trial(ports[mode]))
+        for mode, rs in runs.items():
+            steady = rs[1:] if len(rs) > 1 else rs
+            entry = {
+                "qps": round(sum(r["qps"] for r in steady)
+                             / len(steady), 1),
+                "p50_ms": round(sum(r["p50_ms"] for r in steady)
+                                / len(steady), 2),
+                "p99_ms": round(sum(r["p99_ms"] for r in steady)
+                                / len(steady), 2),
+                "queries": sum(r["queries"] for r in steady),
+            }
+            entry["all_qps"] = [r["qps"] for r in rs]
+            entry["all_p99_ms"] = [r["p99_ms"] for r in rs]
+            if mode == "profiler_on":
+                cl = KeepAliveClient(ports[mode])
+                tick_sum = _scrape_metric(
+                    cl, "profiler_tick_seconds_sum")
+                tick_n = _scrape_metric(
+                    cl, "profiler_tick_seconds_count")
+                if tick_n:
+                    entry["ticks"] = int(tick_n)
+                    entry["tick_us_avg"] = round(
+                        1e6 * tick_sum / tick_n, 1)
+                    # ticks fire hz times per second: the sampler's
+                    # steady-state CPU share is tick cost x hz
+                    entry["duty_cycle"] = round(
+                        (tick_sum / tick_n) * hz, 6)
+                rep = json.loads(cl.get_raw("/debug/profile"))
+                entry["samples"] = rep["data"]["samples"]
+                entry["attribution_fraction"] = \
+                    rep["data"]["attribution_fraction"]
+                entry["roots"] = {
+                    k: v for k, v in sorted(
+                        rep["data"]["roots"].items(),
+                        key=lambda kv: -kv[1])[:8]}
+                cl.close()
+            out[mode] = entry
+    finally:
+        for proc in procs.values():
+            proc.terminate()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    if out.get("profiler_off", {}).get("qps"):
+        off, on = out["profiler_off"], out["profiler_on"]
+        out["qps_ratio_on_vs_off"] = round(
+            on["qps"] / max(off["qps"], 1e-9), 4)
+        out["p99_ratio_on_vs_off"] = round(
+            on["p99_ms"] / max(off["p99_ms"], 1e-9), 4)
+    return out
+
+
 def measure_rules_overhead(clients=8, duration_s=2.5,
                            rule_interval_s=1.0):
     """The dashboard-conversion win (recording rules, filodb_tpu/rules):
@@ -1239,6 +1375,19 @@ def measure_rules_overhead(clients=8, duration_s=2.5,
 
 
 def main():
+    # focused runs: `python bench_e2e.py profiler_overhead ...` runs
+    # only the named measure_* sections (a full run takes minutes; the
+    # per-PR BENCH files usually pin one section)
+    sections = sys.argv[1:]
+    if sections:
+        out = {}
+        for name in sections:
+            fn = globals().get(f"measure_{name}")
+            if fn is None:
+                raise SystemExit(f"unknown section {name!r}")
+            out[name] = fn()
+        print(json.dumps(out))
+        return
     out = measure()
     try:
         out["worker_sweep"] = measure_worker_sweep()
@@ -1256,6 +1405,10 @@ def main():
         out["rules_overhead"] = measure_rules_overhead()
     except Exception as e:  # noqa: BLE001
         out["rules_overhead"] = {"error": repr(e)}
+    try:
+        out["profiler_overhead"] = measure_profiler_overhead()
+    except Exception as e:  # noqa: BLE001
+        out["profiler_overhead"] = {"error": repr(e)}
     print(json.dumps(out))
 
 

@@ -20,6 +20,7 @@ from typing import Optional
 
 from filodb_tpu.grpcsvc import wire
 from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.threads import thread_root
 from filodb_tpu.obs import trace as obs_trace
 from filodb_tpu.query import qos
 
@@ -74,6 +75,14 @@ class GrpcQueryServer:
             futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((Handler(),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
+        # grpc spawns an internal completion-queue polling thread the
+        # AST cannot see; register its actual entry point so the thread
+        # inventory and the sampling profiler both attribute it
+        try:
+            from grpc import _server as _grpc_server
+            thread_root("grpc-serve")(_grpc_server._serve)
+        except (ImportError, AttributeError):
+            pass                # private surface — tolerate its absence
 
     def start(self) -> "GrpcQueryServer":
         self._server.start()

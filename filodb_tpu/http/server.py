@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import json
 import re
+import sys
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,6 +34,7 @@ from filodb_tpu.obs import events as obs_events
 from filodb_tpu.obs import devprof as obs_devprof
 from filodb_tpu.obs import metrics as obs_metrics
 from filodb_tpu.obs import trace as obs_trace
+from filodb_tpu.obs.profiler import SamplingProfiler
 from filodb_tpu.obs.selfmon import SELFMON_DATASET
 from filodb_tpu.obs.slowlog import InflightRegistry, SlowQueryLog
 from filodb_tpu.obs.trace import Tracer
@@ -121,7 +123,8 @@ class FiloHttpServer:
                  slow_query_ms: float = 1000.0,
                  slow_query_capacity: int = 128,
                  peer_fanout_workers: int = 0,
-                 worker_id: Optional[int] = None):
+                 worker_id: Optional[int] = None,
+                 profiler: Optional[SamplingProfiler] = None):
         self.shards_by_dataset = shards_by_dataset
         self.backend = backend
         self.shard_mapper = shard_mapper
@@ -179,6 +182,11 @@ class FiloHttpServer:
         self.slow_log = SlowQueryLog(threshold_ms=float(slow_query_ms),
                                      capacity=int(slow_query_capacity))
         self.inflight = InflightRegistry()
+        # set by the standalone server under --profiler (or injected by
+        # tests): the wall-clock sampling profiler behind /debug/profile.
+        # None (the default) keeps the endpoint a 404 and the metrics
+        # surface untouched.
+        self.profiler = profiler
         # admission control on the QUERY endpoints (query/qos.py): with
         # hundreds of keep-alive connections, unbounded in-flight
         # handlers thrash the GIL (every runnable thread pays switch-
@@ -320,6 +328,16 @@ class FiloHttpServer:
             # handshake completes — raise it to serving levels
             request_queue_size = 128
 
+            # same logical root as _handle below, but marked at the
+            # per-connection thread's SPAWN TARGET: samples taken while
+            # the stdlib is parsing the request line or flushing the
+            # response (no _handle frame on the stack yet/any more)
+            # still attribute to "http-handler"
+            @thread_root("http-handler")
+            def process_request_thread(self, request, client_address):
+                ThreadingHTTPServer.process_request_thread(
+                    self, request, client_address)
+
         self.httpd = _Server((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
@@ -343,9 +361,17 @@ class FiloHttpServer:
         self._extra_listeners: list = []
 
     # -- lifecycle --------------------------------------------------------
+    @thread_root("accept-edge")
+    def _serve_private(self) -> None:
+        # the private-port accept loop shares the "accept-edge" root
+        # with add_listener's extra edges: one inventory entry for
+        # "thread that accepts connections", and a frame the sampling
+        # profiler can attribute
+        self.httpd.serve_forever()
+
     def start(self) -> None:
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+        self._thread = threading.Thread(target=self._serve_private,
+                                        daemon=True, name="accept-edge")
         self._thread.start()
 
     def add_listener(self, sock) -> None:
@@ -543,11 +569,18 @@ class FiloHttpServer:
             body["grpc_peers"] = dict(self.grpc_peers)
             return 200, body
         if path == "/metrics":
-            return 200, self._metrics_text()
+            # ?exemplars=1: content-negotiated OpenMetrics exemplar
+            # suffixes on histogram buckets (metric -> trace links);
+            # the plain exposition stays byte-identical without it
+            want_ex = (self._param(qs, "exemplars", "")
+                       or "").lower() in ("1", "true", "yes")
+            return 200, self._metrics_text(exemplars=want_ex)
         if path.startswith("/admin/"):
             return self._admin(path, qs, body_json)
         if path == "/debug/traces":
             return 200, self._debug_traces(qs)
+        if path == "/debug/profile":
+            return self._debug_profile(qs)
         if path == "/debug/queries":
             return 200, {"status": "success",
                          "data": self.inflight.snapshot()}
@@ -1447,33 +1480,39 @@ class FiloHttpServer:
             trace_id=tr.trace_id if tr is not None else None)
         stages: Dict[str, object] = {}
         t0 = _time.perf_counter()
+        code = 0
         try:
             with obs_trace.activate(tr):
                 with obs_trace.span("query", query=query, dataset=ds,
                                     node=self.node_id or ""):
                     code, payload = self._query_range_stages(
                         engine, qs, ds, query, start, end, step, entry,
-                        stages, force_dict=tr is not None)
+                        stages,
+                        force_dict=tctx is not None or explain_trace)
             if tr is not None and isinstance(payload, dict):
                 if tctx is not None:
                     # peer hop: ship the local spans back; the entry
                     # node's recorder stitches them into ONE trace
                     payload["trace_spans"] = tr.spans_json()
                 else:
-                    self.tracer.finish(tr)
                     if explain_trace:
                         payload["trace"] = tr.to_json()
                     if explain == "analyze":
                         payload["analyze"] = self._build_analyze(
                             tr, stages)
-            elif tr is not None and tctx is None:
-                self.tracer.finish(tr)
             return code, payload
         finally:
+            # tail retention runs HERE so every exit path (success,
+            # QueryError, shed, crash) decides the trace's fate exactly
+            # once, with the outcome in hand
             total_s = _time.perf_counter() - t0
             self.inflight.unregister(entry)
-            obs_metrics.observe("filodb_query_latency_seconds",
-                                _QLAT_HELP, total_s)
+            tr = self._finish_request_trace(
+                tr, tctx, code, total_s, stages,
+                force=explain_trace)
+            obs_metrics.observe(
+                "filodb_query_latency_seconds", _QLAT_HELP, total_s,
+                trace_id=tr.trace_id if tr is not None else None)
             self._maybe_slow_log(total_s, query, ds, "range", engine,
                                  stages, tr)
 
@@ -1483,8 +1522,10 @@ class FiloHttpServer:
         materialize -> execute -> encode, with per-stage spans, the
         in-flight registry's stage pointer, and the ``stages``
         breakdown the slow-query log records. ``force_dict`` routes the
-        encode off the pre-encoded fast path so trace keys can attach
-        (only set when a trace is active)."""
+        encode off the pre-encoded fast path so trace keys can attach —
+        only peer hops (``trace_spans`` rides the envelope) and explain
+        requests need it; a plain request with a pending tail-sampling
+        trace keeps the byte fast path."""
         import time as _time
         t0 = _time.perf_counter()
         self.inflight.stage(entry, "parse")
@@ -1564,9 +1605,9 @@ class FiloHttpServer:
                 and not res.is_hist() and not force_dict:
             # serving fast path: bulk matrix rows encode straight to
             # JSON bytes (memoized ts/value fragments), skipping the
-            # dict tree + json.dumps walk. Traced requests take the
-            # dict path below so spans can ride the envelope —
-            # untraced responses stay byte-identical.
+            # dict tree + json.dumps walk. Peer-hop/explain requests
+            # take the dict path below so spans can ride the envelope —
+            # plain responses (traced or not) stay byte-identical.
             st = engine.stats
             warnings = list(getattr(st, "warnings", ()) or ())
             warnings.extend(res.warnings)
@@ -1583,6 +1624,31 @@ class FiloHttpServer:
             prom_json.attach_degraded(out, res, engine.stats)
         stages["encodeMs"] = round((_time.perf_counter() - t3) * 1000, 3)
         return 200, out
+
+    def _finish_request_trace(self, tr, tctx, code: int, total_s: float,
+                              stages: Dict, force: bool = False):
+        """The tail-retention decision for one finished request (called
+        from the query paths' ``finally``): errors (exception in
+        flight or a 4xx/5xx answer), QoS-shed/degraded rungs, and
+        latency at/above the slow-query threshold always retain the
+        pending trace; the rest keep the start-time sampling coin.
+        Returns the trace iff it was retained (i.e. its id resolves in
+        ``/debug/traces``) — callers link slowlog records and latency
+        exemplars only to that. Peer hops pass through: the entry node
+        owns retention, and the forwarded id still links the stitched
+        entry-node trace."""
+        if tr is None:
+            return None
+        if tctx is not None:
+            return tr
+        err = sys.exc_info()[0] is not None or code >= 400
+        shed = bool(stages.get("qosShed"))
+        will_log = (self.slow_log.enabled
+                    and total_s * 1000.0 >= self.slow_log.threshold_ms)
+        retained = self.tracer.finish_request(
+            tr, error=err, shed=shed, duration_ms=total_s * 1000.0,
+            force=force or will_log)
+        return tr if retained else None
 
     def _maybe_slow_log(self, total_s: float, query: str, ds: str,
                         kind: str, engine, stages: Dict, tr) -> None:
@@ -1624,6 +1690,7 @@ class FiloHttpServer:
             trace_id=tr.trace_id if tr is not None else None)
         stages: Dict[str, object] = {}
         t0 = _time.perf_counter()
+        code = 0
         try:
             with obs_trace.activate(tr):
                 with obs_trace.span("query", query=query, dataset=ds,
@@ -1634,20 +1701,21 @@ class FiloHttpServer:
                 if tctx is not None:
                     payload["trace_spans"] = tr.spans_json()
                 else:
-                    self.tracer.finish(tr)
                     if explain_trace:
                         payload["trace"] = tr.to_json()
                     if explain == "analyze":
                         payload["analyze"] = self._build_analyze(
                             tr, stages)
-            elif tr is not None and tctx is None:
-                self.tracer.finish(tr)
             return code, payload
         finally:
             total_s = _time.perf_counter() - t0
             self.inflight.unregister(entry)
-            obs_metrics.observe("filodb_query_latency_seconds",
-                                _QLAT_HELP, total_s)
+            tr = self._finish_request_trace(
+                tr, tctx, code, total_s, stages,
+                force=explain_trace)
+            obs_metrics.observe(
+                "filodb_query_latency_seconds", _QLAT_HELP, total_s,
+                trace_id=tr.trace_id if tr is not None else None)
             self._maybe_slow_log(total_s, query, ds, "instant", engine,
                                  stages, tr)
 
@@ -1751,6 +1819,34 @@ class FiloHttpServer:
                     for t in traces]
         return {"status": "success",
                 "summary": self.tracer.snapshot(), "data": data}
+
+    def _debug_profile(self, qs):
+        """GET /debug/profile?seconds=N[&format=folded|json]: the
+        sampling profiler's aggregate. ``seconds>0`` profiles a window
+        (delta of the running sampler, or an inline burst when the
+        sampler daemon is off — the handler thread blocks for the
+        window, clamped); ``seconds=0`` reads the cumulative aggregate.
+        ``format=folded`` answers flamegraph-ready folded text."""
+        prof = self.profiler
+        if prof is None:
+            return 404, {"status": "error", "errorType": "unavailable",
+                         "error": "profiler not configured "
+                                  "(--profiler-enabled)"}
+        try:
+            seconds = float(self._param(qs, "seconds", "0") or 0)
+        except ValueError:
+            raise QueryError("seconds must be a number")
+        if seconds > 0:
+            folded, selfs = (prof.window(seconds) if prof.running
+                             else prof.sample_burst(seconds))
+        else:
+            folded, selfs = prof.tables()
+        fmt = (self._param(qs, "format", "json") or "json").lower()
+        if fmt == "folded":
+            return 200, prof.folded_text(folded)
+        return 200, {"status": "success",
+                     "data": prof.report(folded, selfs,
+                                         window_s=seconds or None)}
 
     @staticmethod
     def _query_stats(engine, res) -> Dict:
@@ -2010,10 +2106,11 @@ class FiloHttpServer:
         "filodb_inflight_queries": "Queries currently executing",
     }
 
-    def _metrics_text(self) -> str:
-        return self.build_exposition().render()
+    def _metrics_text(self, exemplars: bool = False) -> str:
+        return self.build_exposition(exemplars=exemplars).render()
 
-    def build_exposition(self) -> "obs_metrics.ExpositionBuilder":
+    def build_exposition(self, exemplars: bool = False
+                         ) -> "obs_metrics.ExpositionBuilder":
         """Prometheus exposition — the Kamon-metrics surface
         (TimeSeriesShardStats, TimeSeriesShard.scala:41; MemoryStats;
         ChunkSourceStats; kamon prometheus reporter in
@@ -2255,12 +2352,36 @@ class FiloHttpServer:
             # global registry and are collected below)
             emit("selfmon_alive", {}, 1 if sm.alive else 0)
             emit("selfmon_interval_seconds", {}, sm.interval_s)
+        # tail-sampling retention + export health: only once tracing is
+        # on (the default exposition stays byte-identical)
+        if self.tracer.enabled:
+            emit("traces_tail_dropped_total", {}, ts["tail_dropped"])
+            for reason, n in sorted(ts["retained"].items()):
+                emit("traces_retained_total", {"reason": reason}, n)
+        exp = self.tracer.exporter
+        if exp is not None:
+            es = exp.snapshot()
+            emit("trace_export_queue", {}, es["queued"])
+            emit("trace_export_enqueued_total", {}, es["enqueued"])
+        # sampling-profiler health (the self-time gauges + tick
+        # histogram ride the global registry below)
+        prof = self.profiler
+        if prof is not None:
+            ps = prof.snapshot()
+            emit("profiler_running", {}, 1 if ps["running"] else 0)
+            emit("profiler_hz", {}, ps["hz"])
+            emit("profiler_samples_total", {}, ps["samples"])
+            emit("profiler_attributed_samples_total", {},
+                 ps["attributed"])
+            emit("profiler_distinct_stacks", {}, ps["distinct_stacks"])
+            emit("profiler_dropped_stacks_total", {},
+                 ps["dropped_stacks"])
         # the global metric registry: counter/gauge families
         # (self-monitor, executable builds), registered collectors
         # (process stats, device-profiler cost gauges), then the
         # stage-latency histograms — query latency, batcher queue wait /
         # batch size, device execute, flush, ingest append + fsync
-        obs_metrics.GLOBAL_REGISTRY.collect_into(b)
+        obs_metrics.GLOBAL_REGISTRY.collect_into(b, exemplars=exemplars)
         return b
 
     def _cardinality(self, ds: str, qs: Dict, local: bool = False):

@@ -324,6 +324,8 @@ def run_lint(paths: Optional[Sequence[str]] = None, *,
     # backend is already up (tests force 8 devices in conftest).
     from filodb_tpu.lint.ulpcert import ensure_virtual_devices
     ensure_virtual_devices()
+    from filodb_tpu.lint import astwalk
+    astwalk.clear()     # fresh memoized-walk cache per run
     _load_rule_modules()
     from filodb_tpu.lint import (rules_cache, rules_capacity,
                                  rules_concurrency, rules_hot,

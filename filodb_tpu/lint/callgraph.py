@@ -51,6 +51,7 @@ from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tup
 from filodb_tpu.lint import ModuleSource
 
 # builtin constructor types we track for blocking-primitive typing
+from filodb_tpu.lint.astwalk import walk_nodes
 _BUILTIN_TYPES = {
     ("threading", "Lock"): "threading.Lock",
     ("threading", "RLock"): "threading.RLock",
@@ -313,7 +314,7 @@ class CallGraph:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 params = {a.arg: self._annotation_type(a.annotation)
                           for a in item.args.args if a.annotation}
-                for sub in ast.walk(item):
+                for sub in walk_nodes(item):
                     if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
                         t = sub.targets[0]
                         if isinstance(t, ast.Attribute) \
@@ -849,7 +850,7 @@ class _BodyWalker:
                                 "del item")
 
     def _declares_global(self, name: str) -> bool:
-        for sub in ast.walk(self.fi.node):
+        for sub in walk_nodes(self.fi.node):
             if isinstance(sub, ast.Global) and name in sub.names:
                 return True
         return False

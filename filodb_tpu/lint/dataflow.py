@@ -45,6 +45,7 @@ from filodb_tpu.lint import ModuleSource
 from filodb_tpu.lint import callgraph as cgmod
 
 # collective primitives that synchronize across a named mesh axis: every
+from filodb_tpu.lint.astwalk import walk_nodes
 # participant must execute the same sequence or the program deadlocks
 # (multi-host) or silently computes over a partial group
 COLLECTIVE_LEAVES = frozenset({
@@ -246,7 +247,7 @@ class MeshIndex:
             makers: Dict[str, Tuple[str, ...]] = {}
             axes_here: Set[str] = set()
             orders_here: Set[Tuple[str, ...]] = set()
-            for node in ast.walk(mod.tree):
+            for node in walk_nodes(mod.tree):
                 if isinstance(node, ast.Call):
                     axes = _mesh_axes_of_call(node)
                     if axes:
@@ -261,7 +262,7 @@ class MeshIndex:
                                 mvars[t.id] = axes
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
-                    for sub in ast.walk(node):
+                    for sub in walk_nodes(node):
                         if isinstance(sub, ast.Return) and \
                                 isinstance(sub.value, ast.Call):
                             axes = _mesh_axes_of_call(sub.value)
@@ -439,12 +440,12 @@ class DeviceDataflow:
             dotted = cgmod.module_dotted(mod.relpath)
             # local Name -> assigned value expr, for mesh resolution
             assigns: Dict[str, ast.AST] = {}
-            for node in ast.walk(mod.tree):
+            for node in walk_nodes(mod.tree):
                 if isinstance(node, ast.Assign):
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             assigns.setdefault(t.id, node.value)
-            for node in ast.walk(mod.tree):
+            for node in walk_nodes(mod.tree):
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                     self._sites_from_decorators(mod, dotted, node, assigns)
@@ -644,7 +645,7 @@ class DeviceDataflow:
             if fi is None:
                 continue
             entries = []
-            for node in ast.walk(fi.node):
+            for node in walk_nodes(fi.node):
                 if isinstance(node, ast.Call):
                     callee_keys = self._callees_at(fi, node.lineno)
                     if callee_keys:
@@ -732,7 +733,7 @@ class DeviceDataflow:
         # one derivation pass: locals assigned from dynamic reads
         for _ in range(2):
             grew = False
-            for node in ast.walk(fi.node):
+            for node in walk_nodes(fi.node):
                 if isinstance(node, ast.Assign):
                     reads = {n.id for n in ast.walk(node.value)
                              if isinstance(n, ast.Name)}
@@ -758,7 +759,7 @@ class DeviceDataflow:
             for mname, mfi in ci.methods.items():
                 node = mfi.node
                 params = {a.arg for a in node.args.args} - {"self"}
-                for sub in ast.walk(node):
+                for sub in walk_nodes(node):
                     # registrar: self.<attr>.append(<param>)
                     if isinstance(sub, ast.Call) \
                             and isinstance(sub.func, ast.Attribute) \

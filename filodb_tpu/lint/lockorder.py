@@ -70,6 +70,11 @@ CANONICAL_LOCK_ORDER: Tuple[str, ...] = (
     # under the lock, samples outside)
     "SelfMonitor._lock",
     "DeviceProfiler._lock",
+    # the wall-clock sampling profiler's folded-stack tables and the
+    # trace exporter's bounded queue: both export through registry
+    # family leaves (below) and never call back up the stack
+    "SamplingProfiler._lock",
+    "TraceExporter._lock",
     "MetricsRegistry._lock",
     "CounterFamily._lock",
     "GaugeFamily._lock",

@@ -44,6 +44,7 @@ register_rule("cache-invalidation-completeness", "cache",
               "a key-affecting event's publisher does not reach a "
               "registered cache's invalidation hook (or a lookup hook "
               "lost its event source)")
+from filodb_tpu.lint.astwalk import walk_nodes
 register_rule("cache-unregistered", "cache",
               "a cache class carries no @cache_registry declaration "
               "(nobody has declared what invalidates it)")
@@ -289,7 +290,7 @@ def check_project(mods: Sequence[ModuleSource],
         attr = None
         init = ci.methods.get("__init__")
         if init is not None and not looks_like:
-            for node in ast.walk(init.node):
+            for node in walk_nodes(init.node):
                 tgt = None
                 if isinstance(node, ast.Assign) \
                         and len(node.targets) == 1:

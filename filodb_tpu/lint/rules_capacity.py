@@ -61,6 +61,7 @@ register_rule("hbm-residency-budget", "capacity",
               "a device allocation escapes into a long-lived store "
               "(object attr / module cache / registry dict) without a "
               "@capacity(bytes_per_sample=..., reason=...) claim")
+from filodb_tpu.lint.astwalk import walk_nodes
 register_rule("device-buffer-leak", "capacity",
               "device arrays retained in a registered store with no "
               "eviction path reachable from its invalidation events, "
@@ -507,7 +508,7 @@ _EVICT_CALL_LEAVES = {"pop", "popitem", "clear"}
 def _evicts_attr(fn_node, attr: str) -> bool:
     """The function body evicts from ``self.<attr>`` (pop/del/clear/
     reassign-to-empty) or wires a weakref finalizer."""
-    for node in ast.walk(fn_node):
+    for node in walk_nodes(fn_node):
         if isinstance(node, ast.Call):
             f = node.func
             if isinstance(f, ast.Attribute) \

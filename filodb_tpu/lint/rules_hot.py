@@ -32,6 +32,7 @@ register_rule(
     "device_get) inside a @hot_path per-query function")
 
 # call leaves that pull device data to host (or block on the device)
+from filodb_tpu.lint.astwalk import walk_nodes
 _TRANSFER_LEAVES = {"asarray", "array", "ascontiguousarray", "item",
                     "block_until_ready", "device_get", "tolist"}
 # numpy-module transfer calls need a numpy alias base; these method
@@ -75,7 +76,7 @@ def _is_hot(node, hot_names: Set[str]) -> bool:
 
 def _numpy_aliases(tree: ast.Module) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in walk_nodes(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name.split(".")[0] == "numpy" \
@@ -95,7 +96,7 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
     findings: List[Finding] = []
 
     hot_fns = []
-    for node in ast.walk(mod.tree):
+    for node in walk_nodes(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and _is_hot(node, hot_names):
             hot_fns.append(node)
@@ -110,7 +111,7 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
 
     for fn in hot_fns:
         # nested defs run in the hot path too: walk the whole subtree
-        for node in ast.walk(fn):
+        for node in walk_nodes(fn):
             if not isinstance(node, ast.Call):
                 continue
             dotted = _dotted(node.func)

@@ -27,6 +27,7 @@ from filodb_tpu.lint import Finding, ModuleSource, register_rule
 
 register_rule("lock-guarded-access", "lock",
               "guarded field accessed outside its declared lock")
+from filodb_tpu.lint.astwalk import walk_nodes
 register_rule("lock-blocking-call", "lock",
               "blocking call made while holding a lock")
 
@@ -68,7 +69,7 @@ def _guarded_by_decl(d: ast.expr) -> Optional[Tuple[str, List[str]]]:
 def collect_declarations(mods: Iterable[ModuleSource]) -> LockDecls:
     decls = LockDecls()
     for mod in mods:
-        for node in ast.walk(mod.tree):
+        for node in walk_nodes(mod.tree):
             if isinstance(node, ast.ClassDef):
                 fields: Dict[str, str] = {}
                 for d in node.decorator_list:

@@ -36,6 +36,7 @@ register_rule(
     "guard")
 
 # call leaves that open/annotate spans (the obs.trace API surface)
+from filodb_tpu.lint.astwalk import walk_nodes
 _SPAN_OPENERS = {"span", "event", "start_span"}
 _SPAN_ANNOTATORS = {"tag"}
 # names in an `if` test that count as the sampling guard
@@ -108,13 +109,13 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
 
     # -- invariant 1: context-manager discipline, whole module ----------
     with_ctx_calls: Set[int] = set()
-    for node in ast.walk(mod.tree):
+    for node in walk_nodes(mod.tree):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 expr = item.context_expr
                 if isinstance(expr, ast.Call):
                     with_ctx_calls.add(id(expr))
-    for node in ast.walk(mod.tree):
+    for node in walk_nodes(mod.tree):
         if not isinstance(node, ast.Call):
             continue
         dotted = _is_span_call(node, {"start_span"})
@@ -130,7 +131,7 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
     # a span/event opened as a DISCARDED expression statement is the
     # same leak (event() is exempt: it is a point annotation that
     # records immediately and returns nothing to close)
-    for node in ast.walk(mod.tree):
+    for node in walk_nodes(mod.tree):
         if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
             dotted = _is_span_call(node.value, {"span"})
             if dotted is not None:
@@ -142,7 +143,7 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
                     context=f"discarded:{dotted}:{node.lineno}"))
 
     # -- invariant 2: no per-call formatting in @hot_path span args -----
-    hot_fns = [n for n in ast.walk(mod.tree)
+    hot_fns = [n for n in walk_nodes(mod.tree)
                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
                and _is_hot(n, hot_names)]
 

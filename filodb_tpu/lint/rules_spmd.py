@@ -51,6 +51,7 @@ register_rule("spmd-collective-balance", "spmd",
               "collective under divergent control flow, lax.cond "
               "branch, or with an axis name absent from the mesh/spec "
               "environment")
+from filodb_tpu.lint.astwalk import walk_nodes
 register_rule("donation-safety", "spmd",
               "donated buffer read after the call, donated twice, or "
               "aliased by live shared state")
@@ -255,7 +256,7 @@ def _closure_has_collective(df: dfmod.DeviceDataflow, key: str) -> bool:
 
 
 def _module_imports_pspec(mod: ModuleSource) -> bool:
-    for node in ast.walk(mod.tree):
+    for node in walk_nodes(mod.tree):
         if isinstance(node, ast.ImportFrom):
             for a in node.names:
                 if a.name == "PartitionSpec":
@@ -357,7 +358,7 @@ def _check_specs(df: dfmod.DeviceDataflow, mods: Sequence[ModuleSource]
         for mod in mods:
             if not _module_imports_pspec(mod):
                 continue
-            for node in ast.walk(mod.tree):
+            for node in walk_nodes(mod.tree):
                 if isinstance(node, ast.Call) \
                         and dfmod._leaf(node.func) in ("P",
                                                        "PartitionSpec"):
@@ -472,7 +473,7 @@ def _check_donation(df: dfmod.DeviceDataflow,
                 plain_jit.setdefault(tgt, site)
     for mod in mods:
         dotted = cgmod.module_dotted(mod.relpath)
-        for node in ast.walk(mod.tree):
+        for node in walk_nodes(mod.tree):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.value, ast.Call):
                 kind = dfmod._wrapper_kind(node.value.func)
@@ -511,7 +512,7 @@ def _check_donation(df: dfmod.DeviceDataflow,
             message=f"{fi.qualname}: {msg}", context=ctx)))
 
     for fi in cg.funcs.values():
-        for node in ast.walk(fi.node):
+        for node in walk_nodes(fi.node):
             if not isinstance(node, ast.Call):
                 continue
             site = self_donating_site(df, fi, node, bound, body_site)
@@ -586,7 +587,7 @@ def _check_donation(df: dfmod.DeviceDataflow,
                              f"{fi.qualname}:aliased:{root}")
     # advisory: rebind loops without donation
     for fi in cg.funcs.values():
-        for loop in ast.walk(fi.node):
+        for loop in walk_nodes(fi.node):
             if not isinstance(loop, (ast.For, ast.While)):
                 continue
             for stmt in ast.walk(loop):
@@ -643,7 +644,7 @@ def self_donating_site(df, fi, call: ast.Call, bound, body_site
 
 
 def _enclosing_assign(fn_node, call: ast.Call) -> Optional[ast.Assign]:
-    for node in ast.walk(fn_node):
+    for node in walk_nodes(fn_node):
         if isinstance(node, ast.Assign) and node.value is call:
             return node
     return None

@@ -43,6 +43,7 @@ from filodb_tpu.lint import Finding, ModuleSource, register_rule
 register_rule("trace-side-effect", "trace",
               "Python side effect inside a jit/shard_map/pallas-traced "
               "function")
+from filodb_tpu.lint.astwalk import walk_nodes
 register_rule("trace-tracer-leak", "trace",
               "tracer escapes to host: .item(), bool()/int()/float() "
               "coercion, or tracer in f-string")
@@ -232,7 +233,7 @@ def _reachable(index: _Index) -> Set[FnInfo]:
         # mentions: a reachable function naming another function pulls
         # it in (helpers called, callbacks passed)
         for f in list(reach):
-            for node in ast.walk(f.node):
+            for node in walk_nodes(f.node):
                 if isinstance(node, ast.Name) \
                         and node.id in index.by_name:
                     for g in index.by_name[node.id]:
@@ -268,7 +269,7 @@ def _locals_of(info: FnInfo) -> Set[str]:
         elif isinstance(t, ast.Starred):
             add_target(t.value)
 
-    for node in ast.walk(info.node):
+    for node in walk_nodes(info.node):
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 add_target(t)
@@ -321,7 +322,7 @@ def check_module(mod: ModuleSource) -> Iterable[Finding]:
         # f-strings inside `raise` build a static error message at trace
         # time — the standard (and harmless) pattern; exempt them
         raise_fmt = {
-            id(n) for r in ast.walk(info.node) if isinstance(r, ast.Raise)
+            id(n) for r in walk_nodes(info.node) if isinstance(r, ast.Raise)
             for n in ast.walk(r) if isinstance(n, ast.FormattedValue)}
         for node in _own_nodes(info, index):
             if isinstance(node, ast.Call):

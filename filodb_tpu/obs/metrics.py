@@ -49,9 +49,22 @@ def _fmt_float(v: float) -> str:
     return repr(float(v))
 
 
-@guarded_by("_lock", "_counts", "_sum", "_count")
+# an exemplar older than this is replaced by ANY fresh observation —
+# "the slowest RECENT fill", not the all-time max
+EXEMPLAR_MAX_AGE_S = 60.0
+
+
+@guarded_by("_lock", "_counts", "_sum", "_count", "_exemplars")
 class Histogram:
-    """One cumulative fixed-bucket histogram (thread-safe observe)."""
+    """One cumulative fixed-bucket histogram (thread-safe observe).
+
+    ``observe(value, trace_id=...)`` optionally attaches an OpenMetrics
+    exemplar to the bucket the value lands in: the (trace_id, value,
+    unix ts) triple of the slowest recent fill, so a latency bucket
+    links straight to the retained trace that filled it. Exemplars cost
+    nothing until the first trace_id-bearing observe and never surface
+    in the exposition unless explicitly requested
+    (``/metrics?exemplars=1``)."""
 
     def __init__(self, name: str, help: str,
                  buckets: Sequence[float] = LATENCY_BUCKETS_S):
@@ -64,13 +77,35 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)   # +Inf tail
         self._sum = 0.0
         self._count = 0
+        # per-bucket (trace_id, value, unix_ts); allocated lazily on
+        # the first exemplar-bearing observe
+        self._exemplars: Optional[List[Optional[Tuple[str, float,
+                                                      float]]]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, value)
         with self._lock:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if trace_id is None:
+                return
+            if self._exemplars is None:
+                self._exemplars = [None] * (len(self.buckets) + 1)
+            cur = self._exemplars[i]
+            now = time.time()
+            if cur is None or value >= cur[1] \
+                    or now - cur[2] > EXEMPLAR_MAX_AGE_S:
+                self._exemplars[i] = (str(trace_id), float(value), now)
+
+    def exemplars(self) -> List[Optional[Tuple[str, float, float]]]:
+        """Per-bucket exemplar snapshot (index-aligned with
+        ``snapshot()['counts']``); all-None when never attached."""
+        with self._lock:
+            if self._exemplars is None:
+                return [None] * (len(self.buckets) + 1)
+            return list(self._exemplars)
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -210,10 +245,13 @@ class MetricsRegistry:
             if fn not in self._collectors:
                 self._collectors.append(fn)
 
-    def collect_into(self, builder: "ExpositionBuilder") -> None:
+    def collect_into(self, builder: "ExpositionBuilder",
+                     exemplars: bool = False) -> None:
         """Walk the whole registry into ``builder``: counter + gauge
         families, registered collectors, then the histograms (sorted by
-        name, matching the /metrics layout)."""
+        name, matching the /metrics layout). ``exemplars=True``
+        (the content-negotiated ``/metrics?exemplars=1``) attaches each
+        histogram bucket's OpenMetrics exemplar."""
         with self._lock:
             counters = list(self._counters.values())
             gauges = list(self._gauges.values())
@@ -233,7 +271,7 @@ class MetricsRegistry:
             except Exception:   # noqa: BLE001 — a collector must never
                 pass            # fail the scrape
         for h in sorted(hists, key=lambda h: h.name):
-            builder.histogram(h)
+            builder.histogram(h, exemplars=exemplars)
 
     def reset(self) -> None:
         """Test hook: drop all registered families. Collectors are
@@ -249,9 +287,12 @@ GLOBAL_REGISTRY = MetricsRegistry()
 
 
 def observe(name: str, help: str, value: float,
-            buckets: Sequence[float] = LATENCY_BUCKETS_S) -> None:
-    """One-line observe into the global registry."""
-    GLOBAL_REGISTRY.histogram(name, help, buckets).observe(value)
+            buckets: Sequence[float] = LATENCY_BUCKETS_S,
+            trace_id: Optional[str] = None) -> None:
+    """One-line observe into the global registry; ``trace_id`` attaches
+    an exemplar (the metric→trace link) to the landing bucket."""
+    GLOBAL_REGISTRY.histogram(name, help, buckets).observe(
+        value, trace_id=trace_id)
 
 
 class timed:
@@ -289,6 +330,21 @@ def escape_help(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
+def format_exemplar(ex: Optional[Tuple[str, float, float]]
+                    ) -> Optional[str]:
+    """OpenMetrics exemplar suffix text for a (trace_id, value, ts)
+    triple — the part after ``# `` on a sample line::
+
+        {trace_id="8ff60ae4"} 0.053 1700000000.123
+
+    None passes through (no exemplar on this bucket)."""
+    if ex is None:
+        return None
+    trace_id, value, ts = ex
+    return (f'{{trace_id="{escape_label(trace_id)}"}} '
+            f"{_fmt_float(value)} {round(float(ts), 3)}")
+
+
 @single_writer("an ExpositionBuilder is constructed, filled, and "
                "rendered by ONE request/scrape thread; instances are "
                "never shared (each /metrics render builds its own)")
@@ -302,7 +358,8 @@ class ExpositionBuilder:
     parses."""
 
     def __init__(self):
-        # family -> (type, help, [(labels_tuple, value_str)])
+        # family -> (type, help, [(name, labels_tuple, value_str,
+        #                          exemplar_suffix_or_None)])
         self._families: "Dict[str, Tuple[str, str, List]]" = {}
         self._order: List[str] = []
 
@@ -313,31 +370,42 @@ class ExpositionBuilder:
 
     def sample(self, name: str, labels: Dict[str, object], value,
                mtype: str = "gauge", help: str = "",
-               family: Optional[str] = None) -> None:
+               family: Optional[str] = None,
+               exemplar: Optional[str] = None) -> None:
         """Add one sample. ``family`` overrides the HELP/TYPE grouping
-        key for histogram children (``x_bucket`` groups under ``x``)."""
+        key for histogram children (``x_bucket`` groups under ``x``).
+        ``exemplar`` is a pre-rendered OpenMetrics exemplar suffix (the
+        text after ``# `` — e.g. ``{trace_id="ab12"} 0.053 1700.2``)
+        appended verbatim at render time; it is never part of the
+        series identity."""
         fam = family or name
         if fam not in self._families:
             self.declare(fam, mtype,
                          help or f"FiloDB metric {fam}")
         self._families[fam][2].append(
             (name, tuple(sorted((str(k), str(v))
-                                for k, v in labels.items())), value))
+                                for k, v in labels.items())), value,
+             exemplar))
 
     def histogram(self, h: Histogram,
-                  labels: Optional[Dict[str, object]] = None) -> None:
+                  labels: Optional[Dict[str, object]] = None,
+                  exemplars: bool = False) -> None:
         labels = labels or {}
         snap = h.snapshot()
+        ex = h.exemplars() if exemplars \
+            else [None] * (len(snap["buckets"]) + 1)
         self.declare(h.name, "histogram", h.help)
         cum = 0
-        for b, c in zip(snap["buckets"], snap["counts"]):
+        for i, (b, c) in enumerate(zip(snap["buckets"],
+                                       snap["counts"])):
             cum += c
             self.sample(h.name + "_bucket",
                         {**labels, "le": _fmt_float(b)}, cum,
-                        family=h.name)
+                        family=h.name,
+                        exemplar=format_exemplar(ex[i]))
         cum += snap["counts"][-1]
         self.sample(h.name + "_bucket", {**labels, "le": "+Inf"}, cum,
-                    family=h.name)
+                    family=h.name, exemplar=format_exemplar(ex[-1]))
         self.sample(h.name + "_sum", labels, snap["sum"],
                     family=h.name)
         self.sample(h.name + "_count", labels, snap["count"],
@@ -358,7 +426,7 @@ class ExpositionBuilder:
             if not samples:
                 continue
             out = []
-            for name, labels, value in samples:
+            for name, labels, value, _ex in samples:
                 key = (name, labels)
                 if key in seen:
                     continue
@@ -375,7 +443,7 @@ class ExpositionBuilder:
                 continue
             lines.append(f"# HELP {fam} {escape_help(help)}")
             lines.append(f"# TYPE {fam} {mtype}")
-            for name, labels, value in samples:
+            for name, labels, value, ex in samples:
                 key = (name, labels)
                 if key in seen:
                     continue        # no duplicate series, ever
@@ -383,9 +451,12 @@ class ExpositionBuilder:
                 if labels:
                     lbl = ",".join(f'{k}="{escape_label(v)}"'
                                    for k, v in labels)
-                    lines.append(f"{name}{{{lbl}}} {value}")
+                    line = f"{name}{{{lbl}}} {value}"
                 else:
-                    lines.append(f"{name} {value}")
+                    line = f"{name} {value}"
+                if ex:
+                    line += f" # {ex}"
+                lines.append(line)
         return "\n".join(lines) + "\n"
 
 
@@ -400,14 +471,19 @@ def _unescape_label(v: str) -> str:
 
 
 def parse_exposition(text: str,
-                     help_sink: Optional[Dict[str, str]] = None
+                     help_sink: Optional[Dict[str, str]] = None,
+                     exemplar_sink: Optional[Dict[Tuple, str]] = None
                      ) -> "List[Tuple[str, str, str, Dict[str, str], str]]":
     """Parse Prometheus text format into
     ``(family, mtype, sample_name, labels, value)`` rows (family = the
     HELP/TYPE grouping name, so ``x_bucket`` rows carry family ``x``).
     ``help_sink`` (optional) collects each family's HELP text.
-    Tolerant of unknown lines (skipped), so a worker running newer code
-    than its supervisor still aggregates."""
+    ``exemplar_sink`` (optional) collects OpenMetrics exemplar suffixes
+    keyed by ``(sample_name, sorted labels tuple)``; without a sink
+    exemplars are stripped, so every consumer (validators, selfmon,
+    aggregation) sees plain samples. Tolerant of unknown lines
+    (skipped), so a worker running newer code than its supervisor still
+    aggregates."""
     out = []
     mtypes: Dict[str, str] = {}
     for ln in text.splitlines():
@@ -426,6 +502,14 @@ def parse_exposition(text: str,
             continue
         if ln.startswith("#"):
             continue
+        # OpenMetrics exemplar suffix: `series value # {labels} v ts`.
+        # Right-most ``" # {"`` anchors the split, so label values
+        # containing a bare " # " stay intact (the suffix itself never
+        # contains the anchor).
+        exemplar = None
+        if " # {" in ln:
+            ln, _, rest = ln.rpartition(" # {")
+            exemplar = "{" + rest
         name_part, _, value = ln.rpartition(" ")
         if not name_part:
             continue
@@ -442,6 +526,9 @@ def parse_exposition(text: str,
             if base and mtypes.get(base) == "histogram":
                 fam = base
                 break
+        if exemplar is not None and exemplar_sink is not None:
+            exemplar_sink[(name, tuple(sorted(labels.items())))] = \
+                exemplar
         out.append((fam, mtypes.get(fam, ""), name, labels, value))
     return out
 
@@ -456,7 +543,9 @@ def merge_expositions(by_worker: "Dict[str, str]",
     ratios side by side)."""
     b = ExpositionBuilder()
     helps: Dict[str, str] = dict(help_table or {})
-    parsed = {w: parse_exposition(by_worker[w], help_sink=helps)
+    exemplars: Dict[str, Dict[Tuple, str]] = {w: {} for w in by_worker}
+    parsed = {w: parse_exposition(by_worker[w], help_sink=helps,
+                                  exemplar_sink=exemplars[w])
               for w in by_worker}
     for worker in sorted(parsed, key=str):
         for fam, mtype, name, labels, value in parsed[worker]:
@@ -469,9 +558,14 @@ def merge_expositions(by_worker: "Dict[str, str]",
             # chains and re-scraped aggregates stay stable)
             lbl = dict(labels)
             lbl.setdefault("worker", str(worker))
+            # a worker's exemplar suffix rides its sample through the
+            # merge unmangled (keyed on the PRE-injection identity, so
+            # re-merging keyed on the already-labeled series also hits)
+            ex = exemplars[worker].get(
+                (name, tuple(sorted(labels.items()))))
             b.sample(name, lbl, value, mtype=mtype,
                      help=helps.get(fam, f"FiloDB metric {fam}"),
-                     family=fam)
+                     family=fam, exemplar=ex)
     return b.render()
 
 

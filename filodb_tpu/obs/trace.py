@@ -33,8 +33,13 @@ import json
 import random
 import threading
 import time
-from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+import urllib.error
+import urllib.request
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from filodb_tpu.lint.locks import guarded_by
+from filodb_tpu.lint.threads import thread_root
 
 # spans per trace cap: a 256-shard fan-out with retries stays well under
 # this; anything bigger is a runaway and gets truncated (tagged).
@@ -98,10 +103,11 @@ class Trace:
     HTTP workers and the device executor both record."""
 
     __slots__ = ("trace_id", "node", "spans", "truncated", "_lock",
-                 "root_parent")
+                 "root_parent", "sampled", "retain_reason")
 
     def __init__(self, trace_id: Optional[str] = None,
-                 node: str = "", root_parent: Optional[str] = None):
+                 node: str = "", root_parent: Optional[str] = None,
+                 sampled: bool = True):
         self.trace_id = trace_id or _new_id()
         self.node = node
         # parent span id carried in from the caller (peer hop); local
@@ -109,6 +115,14 @@ class Trace:
         self.root_parent = root_parent
         self.spans: List[Span] = []
         self.truncated = False
+        # tail sampling: a PENDING trace records spans exactly like a
+        # sampled one, but only survives into the recorder if the
+        # finish-time retention decision (error / shed / slow / coin)
+        # keeps it. ``sampled=False`` marks "coin said drop unless the
+        # outcome is interesting"; ``retain_reason`` is stamped by
+        # Tracer.finish_request for /debug/traces readers.
+        self.sampled = sampled
+        self.retain_reason: Optional[str] = None
         self._lock = threading.Lock()
 
     def add(self, sp: Span) -> None:
@@ -139,9 +153,12 @@ class Trace:
             if s["parent_id"] is None or s["parent_id"] == \
                     self.root_parent:
                 dur = max(dur, s["dur_us"])
-        return {"trace_id": self.trace_id, "node": self.node,
-                "num_spans": len(spans), "duration_us": dur,
-                "truncated": self.truncated, "spans": spans}
+        d = {"trace_id": self.trace_id, "node": self.node,
+             "num_spans": len(spans), "duration_us": dur,
+             "truncated": self.truncated, "spans": spans}
+        if self.retain_reason is not None:
+            d["retained"] = self.retain_reason
+        return d
 
 
 class _NoopSpan:
@@ -355,27 +372,47 @@ class Tracer:
     One per server process (the HTTP server owns it). ``enabled=False``
     (the default) never starts traces — ``span()`` stays on the no-op
     path everywhere. A propagated context from a caller is always
-    honored (the entry node made the sampling decision)."""
+    honored (the entry node made the sampling decision).
+
+    Sampling is TAIL-based: when tracing is enabled, EVERY fresh
+    request records into a cheap pending :class:`Trace`; the sampling
+    coin only decides whether an *uninteresting* outcome survives.
+    :meth:`finish_request` runs the retention decision on outcome —
+    errors, shed/degraded results, and latency above ``slow_ms`` are
+    always retained (so slowlog entries always link a live trace), the
+    rest keep the ``sample_rate`` coin — so the recorder holds the
+    interesting tail instead of a random head. Retained traces are
+    additionally handed to the optional ``exporter``."""
 
     def __init__(self, enabled: bool = False, sample_rate: float = 1.0,
-                 max_traces: int = 256, node: str = ""):
+                 max_traces: int = 256, node: str = "",
+                 slow_ms: float = 0.0,
+                 exporter: Optional["TraceExporter"] = None):
         self.enabled = bool(enabled)
         self.sample_rate = float(sample_rate)
         self.node = node
+        self.slow_ms = float(slow_ms)
+        self.exporter = exporter
         self._lock = threading.Lock()
         self._max = max(1, int(max_traces))
         # trace_id -> Trace; insertion-ordered ring (oldest evicted)
         self._finished: "OrderedDict[str, Trace]" = OrderedDict()
         self.started = 0
         self.sampled_out = 0
+        self.tail_dropped = 0
+        # retention-reason counters (snapshot + /metrics)
+        self.retained: Dict[str, int] = {
+            "sampled": 0, "error": 0, "shed": 0, "slow": 0, "forced": 0}
 
     def start(self, ctx: Optional[Tuple[str, Optional[str]]] = None,
               force: bool = False) -> Optional[Trace]:
         """A Trace for this request, or None (untraced). ``ctx`` is a
         propagated (trace_id, parent_span_id) from the caller — always
-        honored. Fresh requests sample at ``sample_rate``; ``force``
-        (the ``&explain=trace`` opt-in) bypasses both the enable flag
-        and the sampler for one request."""
+        honored. Fresh requests always get a pending trace when tracing
+        is enabled; the ``sample_rate`` coin is flipped HERE but only
+        consulted at finish (tail sampling — see class docstring).
+        ``force`` (the ``&explain=trace`` opt-in) bypasses both the
+        enable flag and the sampler for one request."""
         if ctx is not None:
             self.started += 1
             return Trace(ctx[0], node=self.node, root_parent=ctx[1])
@@ -384,14 +421,53 @@ class Tracer:
                 return None
             if self.sample_rate < 1.0 \
                     and random.random() >= self.sample_rate:
+                # coin says drop — but keep recording: an error/shed/
+                # slow outcome at finish overrides the coin
                 self.sampled_out += 1
-                return None
+                self.started += 1
+                return Trace(node=self.node, sampled=False)
         self.started += 1
         return Trace(node=self.node)
 
+    def finish_request(self, trace: Optional[Trace], *,
+                       error: bool = False, shed: bool = False,
+                       duration_ms: Optional[float] = None,
+                       force: bool = False) -> bool:
+        """The tail-retention decision for an entry-node request trace:
+        record it iff the outcome is interesting (error / QoS shed /
+        above ``slow_ms``) or the start-time coin already kept it (or
+        ``force`` — the explain path). Returns True when retained, so
+        the caller can link the trace id (slowlog, exemplars) only to
+        traces that actually resolve in ``/debug/traces``."""
+        if trace is None:
+            return False
+        slow = (self.slow_ms > 0.0 and duration_ms is not None
+                and duration_ms >= self.slow_ms)
+        if error:
+            reason = "error"
+        elif shed:
+            reason = "shed"
+        elif slow:
+            reason = "slow"
+        elif force:
+            reason = "forced"
+        elif trace.sampled:
+            reason = "sampled"
+        else:
+            with self._lock:
+                self.tail_dropped += 1
+            return False
+        trace.retain_reason = reason
+        with self._lock:
+            self.retained[reason] = self.retained.get(reason, 0) + 1
+        self.finish(trace)
+        return True
+
     def finish(self, trace: Optional[Trace]) -> None:
         """Record a completed ENTRY-NODE trace in the ring buffer (peer
-        hops ship their spans back instead of recording locally)."""
+        hops ship their spans back instead of recording locally).
+        Unconditional — callers wanting tail retention go through
+        :meth:`finish_request`."""
         if trace is None:
             return
         with self._lock:
@@ -399,6 +475,9 @@ class Tracer:
             self._finished.move_to_end(trace.trace_id)
             while len(self._finished) > self._max:
                 self._finished.popitem(last=False)
+        exp = self.exporter
+        if exp is not None:
+            exp.enqueue(trace)
 
     def get(self, trace_id: str) -> Optional[Trace]:
         with self._lock:
@@ -412,5 +491,244 @@ class Tracer:
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
             stored = len(self._finished)
+            retained = dict(self.retained)
+            tail_dropped = self.tail_dropped
         return {"enabled": int(self.enabled), "started": self.started,
-                "sampled_out": self.sampled_out, "stored": stored}
+                "sampled_out": self.sampled_out, "stored": stored,
+                "tail_dropped": tail_dropped, "retained": retained}
+
+
+# -- trace export ------------------------------------------------------------
+
+def _otlp_attr(key: str, value) -> Dict:
+    """One OTLP KeyValue. Everything non-numeric ships as a string —
+    the sink side treats tags as opaque annotations anyway."""
+    if isinstance(value, bool):
+        return {"key": key, "value": {"boolValue": value}}
+    if isinstance(value, int):
+        return {"key": key, "value": {"intValue": str(value)}}
+    if isinstance(value, float):
+        return {"key": key, "value": {"doubleValue": value}}
+    return {"key": key, "value": {"stringValue": str(value)}}
+
+
+def _otlp_span(trace: Trace, d: Dict) -> Dict:
+    """One serialized span (``Span.to_json`` form) as an OTLP/JSON
+    span. Our ids are 64-bit hex: the 128-bit OTLP traceId is
+    zero-padded, spanId ships as-is."""
+    start_ns = int(d.get("start_us", 0)) * 1000
+    dur_us = int(d.get("dur_us", -1))
+    out = {
+        "traceId": str(trace.trace_id).zfill(32),
+        "spanId": str(d.get("span_id", "")).zfill(16),
+        "name": str(d.get("name", "?")),
+        "kind": 1,      # SPAN_KIND_INTERNAL
+        "startTimeUnixNano": str(start_ns),
+        "endTimeUnixNano": str(start_ns + max(0, dur_us) * 1000),
+    }
+    parent = d.get("parent_id")
+    if parent:
+        out["parentSpanId"] = str(parent).zfill(16)
+    attrs = [_otlp_attr(k, v)
+             for k, v in sorted((d.get("tags") or {}).items())]
+    if attrs:
+        out["attributes"] = attrs
+    if d.get("error"):
+        out["status"] = {"code": 2, "message": str(d["error"])}
+    return out
+
+
+def otlp_payload(traces: List[Trace], service: str = "filodb-tpu"
+                 ) -> Dict:
+    """An OTLP/JSON ``ExportTraceServiceRequest``-shaped body for a
+    batch of finished traces (one resourceSpans entry per node)."""
+    by_node: "Dict[str, List[Trace]]" = {}
+    for tr in traces:
+        by_node.setdefault(tr.node or "", []).append(tr)
+    resource_spans = []
+    for node in sorted(by_node):
+        spans = []
+        for tr in by_node[node]:
+            for d in tr.spans_json():
+                spans.append(_otlp_span(tr, d))
+        res_attrs = [_otlp_attr("service.name", service)]
+        if node:
+            res_attrs.append(_otlp_attr("filodb.node", node))
+        resource_spans.append({
+            "resource": {"attributes": res_attrs},
+            "scopeSpans": [{"scope": {"name": "filodb_tpu.obs.trace"},
+                            "spans": spans}],
+        })
+    return {"resourceSpans": resource_spans}
+
+
+def _http_post_json(url: str, body: bytes, timeout_s: float) -> int:
+    """Default transport: POST the OTLP/JSON body; any transport-layer
+    failure (or a 5xx from the sink) raises TransportError so
+    ``resilient_call`` retries and the breaker counts it."""
+    from filodb_tpu.parallel.resilience import TransportError
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return int(resp.status)
+    except urllib.error.HTTPError as e:
+        if e.code >= 500:
+            raise TransportError(f"trace sink {url}: HTTP {e.code}")
+        return int(e.code)      # 4xx: the sink answered; don't retry
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise TransportError(f"trace sink {url}: {e}")
+
+
+@guarded_by("_lock", "_queue", "enqueued", "dropped", "batches",
+            "spans_exported", "failures")
+class TraceExporter:
+    """Bounded background OTLP/JSON trace exporter (a declared thread
+    root).
+
+    Retained traces are enqueued by :meth:`Tracer.finish` (drop-oldest
+    past ``queue_max`` — export lag must never block or balloon the
+    serving path) and a daemon thread flushes batches to the configured
+    sink through :func:`resilient_call`, so the sink gets the full
+    breaker + backoff + deadline stack and a dead sink costs one
+    breaker probe per reset period instead of a hung serving node."""
+
+    def __init__(self, url: str, *, batch_max: int = 64,
+                 interval_s: float = 2.0, queue_max: int = 1024,
+                 timeout_s: float = 5.0, service: str = "filodb-tpu",
+                 transport: Optional[
+                     Callable[[str, bytes, float], int]] = None,
+                 breakers=None, retry=None):
+        self.url = str(url)
+        self.batch_max = max(1, int(batch_max))
+        self.interval_s = max(0.05, float(interval_s))
+        self.queue_max = max(1, int(queue_max))
+        self.timeout_s = float(timeout_s)
+        self.service = service
+        self._transport = transport or _http_post_json
+        self._breakers = breakers
+        self._retry = retry
+        self._lock = threading.Lock()
+        self._queue: "deque[Trace]" = deque()
+        self.enqueued = 0
+        self.dropped = 0
+        self.batches = 0
+        self.spans_exported = 0
+        self.failures = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counter families: the exporter only exists when an export URL
+        # is configured, so registering here never perturbs a default
+        # /metrics exposition
+        from filodb_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.GLOBAL_REGISTRY
+        self._m_batches = reg.counter(
+            "filodb_trace_export_batches_total",
+            "Trace batches successfully POSTed to the export sink")
+        self._m_spans = reg.counter(
+            "filodb_trace_export_spans_total",
+            "Spans shipped to the trace export sink")
+        self._m_dropped = reg.counter(
+            "filodb_trace_export_dropped_total",
+            "Retained traces dropped before export (queue saturation)")
+        self._m_failures = reg.counter(
+            "filodb_trace_export_failures_total",
+            "Export batches abandoned after breaker/retry gave up")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "TraceExporter":
+        if self.running:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="trace-exporter")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- producer side -----------------------------------------------------
+    def enqueue(self, trace: Trace) -> None:
+        """Hand a retained trace to the exporter; never blocks. Oldest
+        queued traces are evicted (and counted) past ``queue_max``."""
+        with self._lock:
+            while len(self._queue) >= self.queue_max:
+                self._queue.popleft()
+                self.dropped += 1
+                self._m_dropped.inc()
+            self._queue.append(trace)
+            self.enqueued += 1
+            full = len(self._queue) >= self.batch_max
+        if full:
+            self._wake.set()
+
+    # -- exporter loop -----------------------------------------------------
+    @thread_root("trace-exporter")
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+            try:
+                self.flush()
+            except Exception:   # noqa: BLE001 — export must not die
+                pass
+        try:
+            self.flush()        # final drain on shutdown
+        except Exception:       # noqa: BLE001
+            pass
+
+    def flush(self) -> int:
+        """Drain the queue in ``batch_max`` bites; returns spans
+        shipped. A batch that exhausts retries (or meets an open
+        breaker) is dropped and counted — export is best-effort by
+        contract."""
+        from filodb_tpu.parallel.resilience import (QueryError,
+                                                    resilient_call)
+        shipped = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return shipped
+                batch = [self._queue.popleft()
+                         for _ in range(min(self.batch_max,
+                                            len(self._queue)))]
+            body = json.dumps(otlp_payload(batch, self.service),
+                              separators=(",", ":")).encode()
+            nspans = sum(len(tr.spans) for tr in batch)
+            try:
+                resilient_call(
+                    lambda t: self._transport(self.url, body, t),
+                    key=f"trace-export:{self.url}",
+                    node_id="trace-export",
+                    timeout_s=self.timeout_s,
+                    retry=self._retry, breakers=self._breakers)
+            except QueryError:
+                with self._lock:
+                    self.failures += 1
+                self._m_failures.inc()
+                continue
+            with self._lock:
+                self.batches += 1
+                self.spans_exported += nspans
+            self._m_batches.inc()
+            self._m_spans.inc(nspans)
+            shipped += nspans
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {"url": self.url, "queued": len(self._queue),
+                    "enqueued": self.enqueued, "dropped": self.dropped,
+                    "batches": self.batches,
+                    "spans_exported": self.spans_exported,
+                    "failures": self.failures, "running": self.running}

@@ -113,6 +113,28 @@ DEFAULTS = {
     "trace-sample-rate": 1.0,
     "trace-max-traces": 256,
     "slow-query-ms": 1000.0,
+    # tail-sampling retention: with tracing enabled, EVERY request
+    # records into a pending trace and the sample-rate coin only
+    # decides uninteresting outcomes — errors, QoS-shed rungs, and
+    # queries slower than trace-slow-ms are ALWAYS retained. None
+    # defaults the slow threshold to slow-query-ms, so slowlog entries
+    # always link a resolvable trace id.
+    "trace-slow-ms": None,
+    # trace export: POST retained traces as OTLP/JSON batches to this
+    # sink URL (None = off) through the breaker+backoff stack; the
+    # queue is bounded drop-oldest (export lag never blocks serving)
+    "trace-export-url": None,
+    "trace-export-batch": 64,
+    "trace-export-interval-s": 2.0,
+    "trace-export-queue": 1024,
+    # wall-clock sampling profiler (obs/profiler.py): OFF by default
+    # (no sampler thread, no metric families, byte-identical /metrics);
+    # when on, /debug/profile serves folded stacks + top self-time and
+    # filodb_profile_self_seconds_total{root,func} rides the registry
+    "profiler-enabled": False,
+    "profiler-hz": 29.0,
+    "profiler-max-stacks": 4096,
+    "profiler-top-n": 20,
     # self-monitoring (obs/selfmon.py): a per-process loop snapshots
     # the metrics registry in-process every interval and ingests the
     # samples into the reserved __selfmon__ dataset through the normal
@@ -364,12 +386,38 @@ class FiloServer:
                            or {}))
 
     def _make_tracer(self):
-        from filodb_tpu.obs.trace import Tracer
+        from filodb_tpu.obs.trace import Tracer, TraceExporter
+        slow_ms = self.config.get("trace-slow-ms")
+        if slow_ms is None:
+            # tail retention inherits the slowlog threshold, so every
+            # slow-query record links a retained (resolvable) trace
+            slow_ms = self.config.get("slow-query-ms", 1000.0)
+        exporter = None
+        url = self.config.get("trace-export-url")
+        if url:
+            exporter = TraceExporter(
+                str(url),
+                batch_max=int(self.config.get("trace-export-batch", 64)),
+                interval_s=float(self.config.get(
+                    "trace-export-interval-s", 2.0)),
+                queue_max=int(self.config.get(
+                    "trace-export-queue", 1024))).start()
         return Tracer(
             enabled=bool(self.config.get("trace-enabled", False)),
             sample_rate=float(self.config.get("trace-sample-rate", 1.0)),
             max_traces=int(self.config.get("trace-max-traces", 256)),
-            node=self.node_id)
+            node=self.node_id,
+            slow_ms=float(slow_ms or 0.0),
+            exporter=exporter)
+
+    def _make_profiler(self):
+        from filodb_tpu.obs.profiler import SamplingProfiler
+        if not self.config.get("profiler-enabled", False):
+            return None
+        return SamplingProfiler(
+            hz=float(self.config.get("profiler-hz", 29.0)),
+            max_stacks=int(self.config.get("profiler-max-stacks", 4096)),
+            top_n=int(self.config.get("profiler-top-n", 20))).start()
 
     def _make_shard(self, shard: int):
         """One shard's full construction — tracker with quota overrides,
@@ -606,6 +654,7 @@ class FiloServer:
             qos_shed_degraded=bool(self.config.get(
                 "qos-shed-degraded", True)),
             tracer=self._make_tracer(),
+            profiler=self._make_profiler(),
             slow_query_ms=float(self.config.get("slow-query-ms",
                                                 1000.0)),
             peer_fanout_workers=int(self.config.get(
@@ -1293,11 +1342,25 @@ class FiloServer:
             if stream is not self.streams.get(shard):
                 stream.close()
         if self.http:
+            if self.http.profiler is not None:
+                self.http.profiler.stop()
+            if self.http.tracer is not None \
+                    and self.http.tracer.exporter is not None:
+                self.http.tracer.exporter.stop()
             self.http.stop()
 
     @property
     def port(self) -> int:
         return self.http.port if self.http else -1
+
+
+@thread_root("main-idle")
+def _main_idle() -> None:
+    # the main thread parks here for the life of the process; a
+    # registered root so the sampling profiler attributes it instead
+    # of counting a permanently-asleep thread as unattributed
+    while True:
+        time.sleep(3600)
 
 
 def main(argv=None) -> int:
@@ -1358,8 +1421,7 @@ def main(argv=None) -> int:
     print(json.dumps(line), flush=True)
     print(f"filodb-tpu server listening on :{server.port}", file=sys.stderr)
     try:
-        while True:
-            time.sleep(3600)
+        _main_idle()
     except KeyboardInterrupt:
         server.stop()
     return 0

@@ -498,7 +498,8 @@ class Supervisor:
         if route == "/metrics":
             return 200, self.metrics_text()
         if route in ("/debug/traces", "/debug/queries",
-                     "/debug/slow_queries", "/debug/threads"):
+                     "/debug/slow_queries", "/debug/threads",
+                     "/debug/events"):
             return 200, self._debug_merge(route, parsed.query)
         if route == "/admin/invalidate" and method == "POST":
             reason = (qs.get("reason") or ["schema"])[0]

@@ -199,3 +199,37 @@ def test_label_escaping_survives_hostile_values(server):
     families = parse_exposition(b.render())
     ((_, labels, _),) = families["filodb_t"][1]
     assert labels["p"] == 'x\\"\\\\\\n'     # escaped on the wire
+
+
+def test_merge_preserves_exemplars_and_is_idempotent():
+    """The supervisor merge passes OpenMetrics exemplar suffixes
+    through unmangled — the worker label lands on the LABELS, never
+    inside the exemplar — and re-merging an already-merged
+    exemplar-bearing payload is a no-op (supervisor-of-supervisors)."""
+    from filodb_tpu.obs.metrics import merge_expositions
+    w0 = (
+        "# HELP filodb_query_latency_seconds Latency\n"
+        "# TYPE filodb_query_latency_seconds histogram\n"
+        'filodb_query_latency_seconds_bucket{le="0.001"} 2'
+        ' # {trace_id="aabbccdd00112233"} 0.0004 1700000000.123\n'
+        'filodb_query_latency_seconds_bucket{le="+Inf"} 3'
+        ' # {trace_id="ffee001122334455"} 2.5 1700000001.5\n'
+        "filodb_query_latency_seconds_sum 2.51\n"
+        "filodb_query_latency_seconds_count 3\n")
+    w1 = (
+        "# HELP filodb_query_latency_seconds Latency\n"
+        "# TYPE filodb_query_latency_seconds histogram\n"
+        'filodb_query_latency_seconds_bucket{le="0.001"} 1\n'
+        'filodb_query_latency_seconds_bucket{le="+Inf"} 1\n'
+        "filodb_query_latency_seconds_sum 0.0002\n"
+        "filodb_query_latency_seconds_count 1\n")
+    merged = merge_expositions({"0": w0, "1": w1})
+    assert ('filodb_query_latency_seconds_bucket'
+            '{le="0.001",worker="0"} 2'
+            ' # {trace_id="aabbccdd00112233"} 0.0004 1700000000.123'
+            ) in merged.splitlines()
+    # the exemplar-less worker gains no suffix
+    assert ('filodb_query_latency_seconds_bucket'
+            '{le="0.001",worker="1"} 1') in merged.splitlines()
+    again = merge_expositions({"sup": merged})
+    assert again == merged

@@ -125,8 +125,12 @@ def test_tracer_sampling_ring_and_force():
     assert t.start() is None                      # disabled: untraced
     assert t.start(force=True) is not None        # &explain=trace
     assert t.start(ctx=("tid", "par")) is not None  # propagated: honored
+    # tail sampling: a coin-fail start still returns a PENDING trace
+    # (marked sampled=False) so retention can be decided at finish time
     t2 = obt.Tracer(enabled=True, sample_rate=0.0, max_traces=2)
-    assert t2.start() is None and t2.sampled_out == 1
+    pend = t2.start()
+    assert pend is not None and not pend.sampled
+    assert t2.sampled_out == 1
     t3 = obt.Tracer(enabled=True, max_traces=2)
     ids = []
     for _ in range(3):

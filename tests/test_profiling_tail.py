@@ -1,0 +1,443 @@
+"""Continuous profiling + tail-sampled traces + exemplar-linked
+metrics (the observability tentpole):
+
+  * tail-based trace retention — errors, QoS sheds, and slow queries
+    ALWAYS keep their trace regardless of the sampling coin, so every
+    slow-query-log record links a trace id that resolves in
+    ``/debug/traces``;
+  * the in-process wall-clock sampling profiler — ``/debug/profile``
+    windows/bursts, folded flamegraph text, thread-root attribution,
+    and the registered sampler/exporter thread roots;
+  * OpenMetrics exemplars on the latency histograms behind
+    ``/metrics?exemplars=1``;
+  * the OTLP/JSON trace exporter (bounded queue, drop-oldest,
+    resilient transport);
+  * and the byte-identity contract: with everything OFF (the
+    defaults), API responses and ``/metrics`` are indistinguishable
+    from a pre-PR server.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.obs import trace as obt
+from filodb_tpu.obs.profiler import UNATTRIBUTED, SamplingProfiler
+from filodb_tpu.standalone.server import FiloServer
+
+T0 = 1_600_000_000
+QUERY = 'rate({_metric_=~"heap_usage|http_requests_total"}[5m])'
+
+
+def _clear_global_exemplars():
+    """The latency histograms ride the process-global registry, so
+    exemplars recorded by one test's server would bleed into the next
+    server's scrape — drop them for a deterministic baseline."""
+    from filodb_tpu.obs.metrics import GLOBAL_REGISTRY
+    with GLOBAL_REGISTRY._lock:
+        hists = list(GLOBAL_REGISTRY._hists.values())
+    for h in hists:
+        with h._lock:
+            h._exemplars = None
+
+
+def _get_raw(port, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=120) as r:
+        return r.headers.get("Content-Type", ""), r.read()
+
+
+def _get(port, path, **params):
+    return json.loads(_get_raw(port, path, **params)[1])
+
+
+def _query(port, **extra):
+    params = dict(query=QUERY, start=T0 + 300, end=T0 + 500, step=60)
+    params.update(extra)
+    return _get(port, "/promql/timeseries/api/v1/query_range",
+                **params)
+
+
+# -- tracer tail-retention semantics (unit) ----------------------------------
+
+def test_tail_retention_reasons_and_precedence():
+    tr = obt.Tracer(enabled=True, sample_rate=0.0, slow_ms=100.0)
+
+    # coin-fail start still hands out a PENDING trace; a boring
+    # outcome at finish drops it (counted), so it never resolves
+    t = tr.start()
+    assert t is not None and not t.sampled
+    assert tr.finish_request(t, duration_ms=1.0) is False
+    assert tr.get(t.trace_id) is None
+    assert tr.snapshot()["tail_dropped"] == 1
+
+    # error beats every other signal
+    t = tr.start()
+    assert tr.finish_request(t, error=True, shed=True,
+                             duration_ms=500.0) is True
+    assert t.retain_reason == "error"
+    assert tr.get(t.trace_id).to_json()["retained"] == "error"
+
+    t = tr.start()
+    assert tr.finish_request(t, shed=True, duration_ms=500.0) is True
+    assert t.retain_reason == "shed"
+
+    t = tr.start()
+    assert tr.finish_request(t, duration_ms=500.0) is True
+    assert t.retain_reason == "slow"        # >= slow_ms threshold
+
+    t = tr.start()
+    assert tr.finish_request(t, duration_ms=1.0, force=True) is True
+    assert t.retain_reason == "forced"
+
+    snap = tr.snapshot()
+    assert snap["retained"] == {"sampled": 0, "error": 1, "shed": 1,
+                                "slow": 1, "forced": 1}
+    assert snap["tail_dropped"] == 1
+
+    # coin-win keeps the boring outcome under reason "sampled"
+    tr2 = obt.Tracer(enabled=True, sample_rate=1.0)
+    t = tr2.start()
+    assert t.sampled
+    assert tr2.finish_request(t, duration_ms=1.0) is True
+    assert t.retain_reason == "sampled"
+    # untagged to_json (head-sampled legacy path) has no retained key
+    plain = obt.Trace()
+    assert "retained" not in plain.to_json()
+
+
+# -- server-level: errors + slow queries always resolve ----------------------
+
+@pytest.fixture
+def tail_server():
+    """Tracing on at a 1% coin with an always-trips slow threshold:
+    the coin keeps (almost) nothing, the tail keeps everything that
+    matters."""
+    srv = FiloServer({
+        "num-shards": 2, "port": 0,
+        "trace-enabled": True, "trace-sample-rate": 0.01,
+        "slow-query-ms": 0.001,         # everything is "slow"
+        "results-cache-mb": 0,
+    }).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3,
+                          start_ms=T0 * 1000)
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_error_and_slow_queries_always_retain_traces(tail_server):
+    srv = tail_server
+    # 5 parse errors: every one must retain a trace under "error"
+    # (the malformed query answers 4xx/5xx; either way the in-flight
+    # exception/error code drives retention)
+    for _ in range(5):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _query(srv.port, query="rate(")
+        assert ei.value.code in (400, 500)
+    # 5 good-but-slow queries (threshold 0.001ms): retained as "slow"
+    for _ in range(5):
+        body = _query(srv.port)
+        assert body["status"] == "success"
+    snap = srv.http.tracer.snapshot()
+    assert snap["retained"]["error"] == 5
+    assert snap["retained"]["slow"] >= 5
+    # every slowlog record links a trace id that RESOLVES
+    slow = _get(srv.port, "/debug/slow_queries")
+    assert slow["status"] == "success" and slow["data"]
+    for rec in slow["data"]:
+        assert rec.get("trace_id"), rec
+        tr = _get(srv.port, "/debug/traces", id=rec["trace_id"])
+        assert tr["status"] == "success"
+        assert tr["data"]["retained"] in ("error", "shed", "slow",
+                                          "forced", "sampled")
+    # the retention counters ride /metrics (tracer is enabled here)
+    _, text = _get_raw(srv.port, "/metrics")
+    text = text.decode()
+    assert 'filodb_traces_retained_total{reason="error"} 5' in text
+    assert "filodb_traces_tail_dropped_total" in text
+
+
+# -- byte-identity with everything off (the defaults) ------------------------
+
+def test_defaults_keep_responses_and_metrics_byte_identical():
+    """Profiler off + tracing off + exemplars unrequested (ALL
+    defaults): responses stay on the canonical compact-JSON fast path
+    (re-encoding the parsed body reproduces the exact bytes), carry no
+    trace keys, and /metrics exposes none of the new families and no
+    exemplar suffixes."""
+    _clear_global_exemplars()
+    # results-cache off so the second request re-executes (a cache hit
+    # zeroes the scan stats — unrelated, pre-existing behavior)
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "results-cache-mb": 0}).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3,
+                          start_ms=T0 * 1000)
+        qs = urllib.parse.urlencode(dict(
+            query=QUERY, start=T0 + 300, end=T0 + 500, step=60))
+        url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+               f"query_range?{qs}")
+        with urllib.request.urlopen(url, timeout=120) as r:
+            raw1 = r.read()
+        with urllib.request.urlopen(url, timeout=120) as r:
+            raw2 = r.read()
+        parsed1, parsed2 = json.loads(raw1), json.loads(raw2)
+        assert "trace" not in parsed1 and "trace_spans" not in parsed1
+        assert raw1 == json.dumps(parsed1,
+                                  separators=(",", ":")).encode()
+        parsed1["stats"].pop("timings")
+        parsed2["stats"].pop("timings")
+        assert parsed1 == parsed2
+        assert srv.http.tracer.snapshot()["started"] == 0
+        assert srv.http.profiler is None
+        # the exemplars=1 flag must be the ONLY way suffixes appear —
+        # and with no retained traces there are none to attach anyway
+        _, plain = _get_raw(srv.port, "/metrics")
+        _, flagged = _get_raw(srv.port, "/metrics", exemplars=1)
+        for text in (plain.decode(), flagged.decode()):
+            assert " # {" not in text
+            assert "filodb_profile_self_seconds_total" not in text
+            assert "filodb_profiler_tick_seconds" not in text
+            assert "filodb_trace_export" not in text
+            assert "filodb_traces_retained_total" not in text
+            assert "filodb_traces_tail_dropped_total" not in text
+        # /debug/profile is a clean 404 when the profiler is off
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/debug/profile")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# -- exemplars on the wire ---------------------------------------------------
+
+def test_latency_exemplars_link_retained_traces():
+    _clear_global_exemplars()
+    srv = FiloServer({
+        "num-shards": 2, "port": 0,
+        "trace-enabled": True, "trace-sample-rate": 1.0,
+        "results-cache-mb": 0,
+    }).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3,
+                          start_ms=T0 * 1000)
+        for _ in range(3):
+            assert _query(srv.port)["status"] == "success"
+        _, plain = _get_raw(srv.port, "/metrics")
+        _, flagged = _get_raw(srv.port, "/metrics", exemplars=1)
+        plain, flagged = plain.decode(), flagged.decode()
+        assert " # {" not in plain          # opt-in only
+        ex_lines = [ln for ln in flagged.splitlines()
+                    if ln.startswith("filodb_query_latency_seconds_"
+                                     "bucket") and " # {" in ln]
+        assert ex_lines, "no exemplar-bearing latency buckets"
+        # every exemplar's trace id resolves in /debug/traces
+        ids = set()
+        for ln in ex_lines:
+            suffix = ln.rsplit(" # ", 1)[1]
+            assert suffix.startswith('{trace_id="')
+            ids.add(suffix.split('"')[1])
+        for tid in ids:
+            got = _get(srv.port, "/debug/traces", id=tid)
+            assert got["status"] == "success"
+    finally:
+        srv.stop()
+
+
+# -- profiler ----------------------------------------------------------------
+
+def test_sampler_and_exporter_are_registered_thread_roots():
+    from filodb_tpu.lint.threads import THREAD_ROOTS
+    names = {info["name"] for info in THREAD_ROOTS.values()}
+    assert "profiler-sampler" in names
+    assert "trace-exporter" in names
+
+
+def test_profiler_tick_attributes_registered_roots():
+    """A direct tick() with a thread parked inside a @thread_root
+    function attributes that stack by FRAME match (the thread's OS
+    name is a stdlib default, so name fallback can't be the one
+    matching)."""
+    prof = SamplingProfiler(hz=50.0)
+    release = threading.Event()
+
+    from filodb_tpu.obs.selfmon import SelfMonitor
+    mon = SelfMonitor.__new__(SelfMonitor)
+    mon.interval_s = 60.0
+    mon._stop = release
+
+    def park():
+        # sits inside SelfMonitor._run (@thread_root "selfmon-loop")
+        # waiting on the event — the sampled stack walks through it
+        mon._run()
+
+    t = threading.Thread(target=park)    # default "Thread-N" name
+    t.start()
+    try:
+        for _ in range(3):
+            prof.tick()
+        folded, selfs = prof.tables()
+        assert any(k.startswith("selfmon-loop;") for k in folded)
+        assert any(root == "selfmon-loop" for root, _ in selfs)
+        snap = prof.snapshot()
+        assert snap["samples"] > 0 and snap["attributed"] > 0
+        # folded text is flamegraph-shaped: "stack count" lines
+        for ln in prof.folded_text().splitlines():
+            stack, n = ln.rsplit(" ", 1)
+            assert ";" in stack and int(n) >= 1
+    finally:
+        release.set()
+        t.join(timeout=5)
+
+
+def test_profiler_bounded_stacks_overflow_bucket():
+    prof = SamplingProfiler(hz=10.0, max_stacks=64)
+    with prof._lock:
+        for i in range(64):
+            prof._folded[f"r;m.f{i}"] = 1
+    # a NEW distinct stack past the cap folds into the overflow bucket
+    release = threading.Event()
+    t = threading.Thread(target=release.wait)
+    t.start()
+    try:
+        prof.tick()
+    finally:
+        release.set()
+        t.join(timeout=5)
+    folded, _ = prof.tables()
+    assert len([k for k in folded if ";" + "(overflow)" in k
+                or k.endswith("(overflow)")]) >= 1
+    assert prof.snapshot()["dropped_stacks"] >= 1
+
+
+@pytest.fixture
+def prof_server():
+    srv = FiloServer({"num-shards": 2, "port": 0,
+                      "profiler-enabled": True,
+                      "profiler-hz": 97.0}).start()
+    try:
+        srv.seed_dev_data(n_samples=60, n_instances=3,
+                          start_ms=T0 * 1000)
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_debug_profile_window_and_folded(prof_server):
+    srv = prof_server
+    assert srv.http.profiler is not None and srv.http.profiler.running
+    for _ in range(2):
+        _query(srv.port)
+    body = _get(srv.port, "/debug/profile", seconds=0.4)
+    assert body["status"] == "success"
+    rep = body["data"]
+    assert rep["samples"] > 0
+    assert rep["window_s"] == 0.4
+    assert rep["top_self"] and all(
+        set(e) == {"root", "func", "samples", "self_seconds"}
+        for e in rep["top_self"])
+    # the handler thread itself is parked in the window — attributed
+    # to the http-handler root by frame walk, not thread name
+    assert "http-handler" in rep["roots"]
+    known = sum(n for r, n in rep["roots"].items()
+                if r != UNATTRIBUTED)
+    assert known > 0
+    ctype, text = _get_raw(srv.port, "/debug/profile", seconds=0.2,
+                           format="folded")
+    assert ctype.startswith("text/plain")
+    lines = text.decode().splitlines()
+    assert lines and all(ln.rsplit(" ", 1)[1].isdigit()
+                         for ln in lines)
+    # the sampler exports its self-time gauge + tick histogram
+    _, mtext = _get_raw(srv.port, "/metrics")
+    mtext = mtext.decode()
+    assert "filodb_profile_self_seconds_total" in mtext
+    assert "filodb_profiler_tick_seconds_count" in mtext
+    assert "filodb_profiler_running 1" in mtext
+
+
+# -- trace exporter ----------------------------------------------------------
+
+def _mk_trace(name="q"):
+    tr = obt.Trace(node="n0")
+    with obt.activate(tr):
+        with obt.span(name, ds="timeseries"):
+            pass
+    tr.retain_reason = "slow"
+    return tr
+
+
+def test_exporter_ships_otlp_batches_and_drops_oldest():
+    sent = []
+
+    def transport(url, body, timeout_s):
+        sent.append((url, json.loads(body)))
+        return 200
+
+    exp = obt.TraceExporter("http://sink:4318/v1/traces", batch_max=2,
+                            queue_max=3, transport=transport)
+    for i in range(5):                  # queue_max=3: 2 oldest dropped
+        exp.enqueue(_mk_trace(f"q{i}"))
+    assert exp.snapshot()["dropped"] == 2
+    shipped = exp.flush()
+    assert shipped == 3 and len(sent) == 2      # 2+1 in batch_max bites
+    url, payload = sent[0]
+    assert url == "http://sink:4318/v1/traces"
+    rs = payload["resourceSpans"][0]
+    attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert attrs["service.name"] == {"stringValue": "filodb-tpu"}
+    spans = rs["scopeSpans"][0]["spans"]
+    assert spans
+    for sp in spans:
+        assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+        int(sp["startTimeUnixNano"])    # stringified nanos
+    snap = exp.snapshot()
+    assert snap["batches"] == 2 and snap["spans_exported"] == shipped
+
+
+def test_exporter_counts_failures_and_keeps_serving():
+    def transport(url, body, timeout_s):
+        from filodb_tpu.parallel.resilience import TransportError
+        raise TransportError("sink down")
+
+    from filodb_tpu.parallel.resilience import RetryPolicy
+    exp = obt.TraceExporter("http://down-sink:4318/v1/traces",
+                            transport=transport,
+                            retry=RetryPolicy(max_attempts=2,
+                                              base_delay_s=0.0,
+                                              jitter=0.0))
+    exp.enqueue(_mk_trace())
+    assert exp.flush() == 0
+    snap = exp.snapshot()
+    assert snap["failures"] == 1 and snap["batches"] == 0
+    # a later healthy flush is unaffected (fresh queue drains clean)
+    exp._transport = lambda url, body, t: 200
+    exp.enqueue(_mk_trace())
+    assert exp.flush() == 1
+
+
+def test_exporter_thread_lifecycle_with_stub_transport():
+    got = threading.Event()
+
+    def transport(url, body, timeout_s):
+        got.set()
+        return 200
+
+    exp = obt.TraceExporter("http://sink:4318/v1/traces",
+                            interval_s=0.05, transport=transport)
+    exp.start()
+    try:
+        assert exp.running
+        exp.enqueue(_mk_trace())
+        assert got.wait(5.0)
+    finally:
+        exp.stop()
+    assert not exp.running
+    assert exp.snapshot()["spans_exported"] >= 1

@@ -459,3 +459,34 @@ def test_aggregate_tenant_families_host_rollup():
     # non-tenant families are not rolled up
     assert "filodb_host_tenant_time_series_total" in out
     assert aggregate_tenant_families({}) == ""
+
+
+# -- /debug/events fleet merge ------------------------------------------------
+
+def test_debug_events_merged_and_worker_tagged():
+    """/debug/events joins the supervisor's merged debug routes: the
+    admin port fans the request out to every worker and concatenates
+    the event journals, each entry tagged with its worker ordinal —
+    one place to read corruption/quarantine/read-only transitions for
+    the whole host."""
+    from filodb_tpu.standalone.supervisor import Supervisor, _Worker
+    sup = Supervisor({"serving-workers": 2, "port": 0})
+    sup._workers = {0: _Worker(0, "w0.json", 1), 1: _Worker(1, "w1.json", 2)}
+    canned = {
+        1: {"status": "success",
+            "data": [{"kind": "corruption-detected", "shard": 0}]},
+        2: {"status": "success",
+            "data": [{"kind": "ingest-read-only", "shard": 3}]},
+    }
+    sup._worker_get = lambda w, path: (
+        canned[w.port] if path.startswith("/debug/events") else None)
+    code, body = sup._admin_route("/debug/events?limit=10")
+    assert code == 200 and body["status"] == "success"
+    assert {(e["kind"], e["worker"]) for e in body["data"]} == {
+        ("corruption-detected", 0), ("ingest-read-only", 1)}
+    # the ?query passes through to the workers
+    seen = []
+    sup._worker_get = lambda w, path: (seen.append(path)
+                                       or canned[w.port])
+    sup._admin_route("/debug/events?kind=quarantine")
+    assert seen == ["/debug/events?kind=quarantine"] * 2
